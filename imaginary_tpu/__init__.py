@@ -1,0 +1,27 @@
+"""imaginary-tpu: a TPU-native HTTP image-processing service framework.
+
+A ground-up rebuild of the capabilities of `imaginary` (Go + bimg/libvips;
+reference at /root/reference) designed TPU-first: the dense pixel work runs as
+batched, jit-compiled JAX/XLA programs over a `jax.sharding.Mesh`, requests
+are fanned into a micro-batch queue with dynamic-shape bucketing, and whole
+pipeline chains fuse into a single compiled program (decode once / encode
+once). Decode/encode and text rasterization stay on host behind a native
+codec layer.
+
+Package layout:
+  params.py / options.py  request-parameter surface (ref: params.go, options.go)
+  imgtype.py              MIME <-> format mapping       (ref: type.go)
+  errors.py               typed HTTP errors             (ref: error.go)
+  codecs/                 host decode/encode/metadata   (ref: bimg/libvips codecs)
+  ops/                    pure JAX pixel kernels        (ref: image.go -> libvips)
+  engine/                 micro-batch executor, jit cache, bucketing
+  parallel/               mesh + sharding helpers
+  sources/                http/fs/body image sources    (ref: source_*.go)
+  web/                    server, middleware, controllers (ref: server.go, middleware.go, controllers.go)
+"""
+
+from imaginary_tpu.version import Version, VersionInfo
+
+__version__ = Version
+
+__all__ = ["Version", "VersionInfo", "__version__"]

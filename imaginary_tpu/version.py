@@ -1,0 +1,43 @@
+"""Version info (ref: version.go:1-11, controllers.go:17-26).
+
+The reference reports {imaginary, bimg, libvips} versions on `/`; we report
+{imaginary_tpu, jax, backend} — the JAX/XLA stack plays the role bimg/libvips
+play in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+Version = "1.0.0"
+
+
+@dataclasses.dataclass(frozen=True)
+class VersionInfo:
+    """JSON body of the `/` endpoint."""
+
+    imaginary_tpu: str
+    jax: str
+    backend: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def current_versions() -> VersionInfo:
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        jax_version = "unavailable"
+    return VersionInfo(imaginary_tpu=Version, jax=jax_version, backend=_backend_name())
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "unknown"

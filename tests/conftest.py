@@ -1,0 +1,43 @@
+"""Test harness configuration.
+
+All tests run on CPU with 8 virtual XLA devices so the multi-chip sharding
+paths compile and execute without TPU hardware (SURVEY.md section 4.6). This
+must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Make the repo root importable when pytest is run from anywhere.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import pytest  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "testdata")
+
+
+@pytest.fixture(scope="session")
+def testdata():
+    """Path to the generated fixture directory (see tests/gen_fixtures.py)."""
+    if not os.path.isdir(FIXTURES) or not os.listdir(FIXTURES):
+        from tests.gen_fixtures import generate_all
+
+        generate_all(FIXTURES)
+    return FIXTURES
+
+
+def fixture_bytes(name: str) -> bytes:
+    path = os.path.join(FIXTURES, name)
+    if not os.path.exists(path):
+        from tests.gen_fixtures import generate_all
+
+        generate_all(FIXTURES)
+    with open(path, "rb") as f:
+        return f.read()

@@ -1,0 +1,89 @@
+"""Deterministic test-fixture generator.
+
+The reference ships binary fixtures in testdata/ (SURVEY.md section 4.5:
+imaginary.jpg 550x740, large.jpg 1920x1080, test.png, test.webp,
+smart-crop.jpg, 1024bytes). We generate equivalents procedurally so the repo
+carries no opaque binaries and fixtures are reproducible: seeded gradients
+plus geometric shapes, saved via PIL (the independent codec oracle — the
+framework's own codec layer is never used to produce fixtures).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+
+def _base_array(w: int, h: int, seed: int) -> np.ndarray:
+    """Gradient background + deterministic rectangles/disks, HWC uint8."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    r = (xx * 255.0 / max(w - 1, 1)).astype(np.uint8)
+    g = (yy * 255.0 / max(h - 1, 1)).astype(np.uint8)
+    b = ((xx + yy) * 255.0 / max(w + h - 2, 1)).astype(np.uint8)
+    img = np.stack([r, g, b], axis=-1)
+    for _ in range(6):
+        x0, y0 = int(rng.integers(0, w)), int(rng.integers(0, h))
+        bw, bh = int(rng.integers(w // 8, w // 3)), int(rng.integers(h // 8, h // 3))
+        color = rng.integers(0, 256, size=3)
+        img[y0 : min(y0 + bh, h), x0 : min(x0 + bw, w)] = color
+    for _ in range(4):
+        cx, cy = int(rng.integers(0, w)), int(rng.integers(0, h))
+        rad = int(rng.integers(min(w, h) // 12, min(w, h) // 5))
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= rad * rad
+        img[mask] = rng.integers(0, 256, size=3)
+    return img
+
+
+def _smart_crop_array(w: int, h: int) -> np.ndarray:
+    """Flat background with one high-contrast salient patch off-centre, so
+    smartcrop tests have an unambiguous attention target."""
+    img = np.full((h, w, 3), 230, dtype=np.uint8)
+    cx, cy, rad = int(w * 0.75), int(h * 0.3), min(w, h) // 8
+    yy, xx = np.mgrid[0:h, 0:w]
+    mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= rad * rad
+    img[mask] = (200, 30, 30)
+    ring = ((xx - cx) ** 2 + (yy - cy) ** 2 <= (rad + 6) ** 2) & ~mask
+    img[ring] = (10, 10, 10)
+    return img
+
+
+def generate_all(out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+
+    def save(arr: np.ndarray, name: str, **kw) -> None:
+        path = os.path.join(out_dir, name)
+        if not os.path.exists(path):
+            Image.fromarray(arr).save(path, **kw)
+
+    # Same dimensions as the reference fixtures (server_test.go, image_test.go).
+    save(_base_array(550, 740, seed=1), "imaginary.jpg", quality=90)
+    save(_base_array(1920, 1080, seed=2), "large.jpg", quality=92)
+    save(_base_array(1024, 768, seed=3), "medium.jpg", quality=90)
+    save(_base_array(512, 512, seed=4), "test.png")
+    save(_base_array(512, 512, seed=5), "test.webp", quality=90)
+    save(_base_array(320, 240, seed=6), "test.gif")
+    save(_smart_crop_array(800, 600), "smart-crop.jpg", quality=92)
+
+    # EXIF orientation-6 fixture (90 deg CW needed to display upright):
+    # a 400x300 sensor image tagged orientation 6 -> upright size 300x400.
+    exif_path = os.path.join(out_dir, "exif-orient-6.jpg")
+    if not os.path.exists(exif_path):
+        im = Image.fromarray(_base_array(400, 300, seed=7))
+        exif = Image.Exif()
+        exif[274] = 6  # 274 = Orientation tag
+        im.save(exif_path, quality=90, exif=exif)
+
+    # Exactly 1024 bytes of non-image data (size-limit fixture,
+    # source_http_test.go:270-298).
+    kb_path = os.path.join(out_dir, "1024bytes")
+    if not os.path.exists(kb_path):
+        with open(kb_path, "wb") as f:
+            f.write(bytes(range(256)) * 4)
+
+
+if __name__ == "__main__":
+    generate_all(os.path.join(os.path.dirname(os.path.abspath(__file__)), "testdata"))
+    print("fixtures written")

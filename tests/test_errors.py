@@ -1,0 +1,31 @@
+"""Error machinery tests (modeled on error_test.go:5-24)."""
+
+import json
+
+from imaginary_tpu.errors import ErrNotFound, ImageError, new_error
+
+
+def test_error_shape():
+    e = new_error("oops", 400)
+    assert e.message == "oops"
+    assert e.http_code() == 400
+    body = json.loads(e.json_bytes())
+    assert body == {"message": "oops", "status": 400}
+
+
+def test_error_strips_newlines():
+    e = new_error("multi\nline\nmessage", 400)
+    assert e.message == "multilinemessage"
+
+
+def test_http_code_clamped():
+    assert new_error("x", 200).http_code() == 503
+    assert new_error("x", 399).http_code() == 503
+    assert new_error("x", 512).http_code() == 503
+    assert new_error("x", 400).http_code() == 400
+    assert new_error("x", 511).http_code() == 511
+
+
+def test_predefined():
+    assert ErrNotFound.code == 404
+    assert isinstance(ErrNotFound, ImageError)

@@ -1,0 +1,53 @@
+"""Options-model tests (modeled on options_test.go)."""
+
+from imaginary_tpu.options import (
+    ImageOptions,
+    apply_aspect_ratio,
+    parse_aspect_ratio,
+    should_transform_by_aspect_ratio,
+    transform_by_aspect_ratio,
+)
+
+
+def test_parse_aspect_ratio():
+    assert parse_aspect_ratio("16:9") == {"width": 16, "height": 9}
+    assert parse_aspect_ratio(" 4:3 ") == {"width": 4, "height": 3}
+    assert parse_aspect_ratio("16") is None
+    assert parse_aspect_ratio("") is None
+    assert parse_aspect_ratio("a:b") == {"width": 0, "height": 0}
+
+
+def test_should_transform():
+    assert should_transform_by_aspect_ratio(100, 0)
+    assert should_transform_by_aspect_ratio(0, 100)
+    assert not should_transform_by_aspect_ratio(100, 100)
+    assert not should_transform_by_aspect_ratio(0, 0)
+
+
+def test_transform_by_aspect_ratio_reference_math():
+    # The reference uses truncating division: w // arW * arH (options.go:92-94)
+    w, h = transform_by_aspect_ratio(1600, 0, {"width": 16, "height": 9})
+    assert (w, h) == (1600, 900)
+    w, h = transform_by_aspect_ratio(0, 900, {"width": 16, "height": 9})
+    assert (w, h) == (1600, 900)
+    # truncation behavior: 333 // 16 * 9 = 180 (not round(333*9/16)=187)
+    w, h = transform_by_aspect_ratio(333, 0, {"width": 16, "height": 9})
+    assert (w, h) == (333, 180)
+
+
+def test_apply_aspect_ratio():
+    o = ImageOptions(width=1600, aspect_ratio="16:9")
+    assert apply_aspect_ratio(o) == (1600, 900)
+    # both dims given: ratio ignored
+    o = ImageOptions(width=100, height=100, aspect_ratio="16:9")
+    assert apply_aspect_ratio(o) == (100, 100)
+    # no ratio: unchanged
+    o = ImageOptions(width=100)
+    assert apply_aspect_ratio(o) == (100, 0)
+
+
+def test_parse_aspect_ratio_go_atoi_strictness():
+    # Go strconv.Atoi rejects inner padding and underscores -> 0
+    assert parse_aspect_ratio("16 : 9") == {"width": 0, "height": 0}
+    assert parse_aspect_ratio("1_6:9") == {"width": 0, "height": 9}
+    assert parse_aspect_ratio("+16:9") == {"width": 16, "height": 9}

@@ -1,0 +1,223 @@
+"""Parameter-coercion tests, modeled on the reference's table-driven suite
+(params_test.go:12-157 and :283-407)."""
+
+import pytest
+
+from imaginary_tpu.options import Colorspace, Extend, Gravity
+from imaginary_tpu.params import (
+    ParamError,
+    build_params_from_operation,
+    build_params_from_query,
+    parse_bool,
+    parse_color,
+    parse_colorspace,
+    parse_extend_mode,
+    parse_float,
+    parse_gravity,
+    parse_int,
+    parse_json_operations,
+)
+from imaginary_tpu.options import PipelineOperation
+
+
+def test_read_params():
+    q = {
+        "width": "100",
+        "height": "80",
+        "noreplicate": "1",
+        "opacity": "0.2",
+        "text": "hello",
+        "background": "255,10,20",
+        "interlace": "true",
+    }
+    p = build_params_from_query(q)
+    assert p.width == 100
+    assert p.height == 80
+    assert p.no_replicate is True
+    assert p.opacity == pytest.approx(0.2)
+    assert p.text == "hello"
+    assert p.background == (255, 10, 20)
+    assert p.interlace is True
+    # builder default (params.go:356)
+    assert p.extend is Extend.COPY
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [("1", 1), ("0100", 100), ("-100", 100), ("99.02", 99), ("99.9", 100), ("", 0)],
+)
+def test_parse_int(value, expected):
+    assert parse_int(value) == expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [("1.1", 1.1), ("01.1", 1.1), ("-1.10", 1.10), ("99.999999", 99.999999), ("", 0.0)],
+)
+def test_parse_float(value, expected):
+    assert parse_float(value) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [("true", True), ("false", False), ("1", True), ("-1", None), ("0", False),
+     ("1.1", None), ("0.0", None), ("no", None), ("yes", None), ("", False)],
+)
+def test_parse_bool(value, expected):
+    if expected is None:
+        with pytest.raises(ParamError):
+            parse_bool(value)
+    else:
+        assert parse_bool(value) is expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        ("200,100,20", (200, 100, 20)),
+        ("0,280,200", (0, 255, 200)),
+        (" -1, 256 , 50", (0, 255, 50)),
+        (" a, 20 , &hel0", (0, 20, 0)),
+        ("", ()),
+    ],
+)
+def test_parse_color(value, expected):
+    assert parse_color(value) == expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        ("white", Extend.WHITE),
+        ("black", Extend.BLACK),
+        ("copy", Extend.COPY),
+        ("mirror", Extend.MIRROR),
+        ("background", Extend.BACKGROUND),
+        ("lastpixel", Extend.LAST),
+        (" Black ", Extend.BLACK),
+        ("unknown", Extend.MIRROR),
+        ("", Extend.MIRROR),
+    ],
+)
+def test_parse_extend(value, expected):
+    assert parse_extend_mode(value) is expected
+
+
+@pytest.mark.parametrize(
+    "value,expected",
+    [
+        ("north", Gravity.NORTH),
+        ("south", Gravity.SOUTH),
+        ("east", Gravity.EAST),
+        ("west", Gravity.WEST),
+        ("smart", Gravity.SMART),
+        (" SMART ", Gravity.SMART),
+        ("centre", Gravity.CENTRE),
+        ("bogus", Gravity.CENTRE),
+        ("", Gravity.CENTRE),
+    ],
+)
+def test_parse_gravity(value, expected):
+    assert parse_gravity(value) is expected
+
+
+def test_parse_colorspace():
+    assert parse_colorspace("bw") is Colorspace.BW
+    assert parse_colorspace("srgb") is Colorspace.SRGB
+    assert parse_colorspace("") is Colorspace.SRGB
+
+
+class TestCoercion:
+    """Mirrors TestCoerceTypeFns (params_test.go:283-407): each typed coercer
+    accepts JSON-native values as well as strings."""
+
+    def test_int_accepts_json_number(self):
+        p = build_params_from_operation(PipelineOperation(params={"width": 300}))
+        assert p.width == 300
+        p = build_params_from_operation(PipelineOperation(params={"width": 300.7}))
+        assert p.width == 300  # Go float64->int truncates
+
+    def test_bool_accepts_json_bool(self):
+        p = build_params_from_operation(PipelineOperation(params={"force": True}))
+        assert p.force is True
+        assert p.is_defined("force")
+
+    def test_float_accepts_json_number(self):
+        p = build_params_from_operation(PipelineOperation(params={"opacity": 0.5}))
+        assert p.opacity == pytest.approx(0.5)
+
+    def test_string_rejects_number(self):
+        with pytest.raises(ParamError):
+            build_params_from_operation(PipelineOperation(params={"text": 5}))
+
+    def test_bool_rejects_number(self):
+        with pytest.raises(ParamError):
+            build_params_from_operation(PipelineOperation(params={"force": 5}))
+
+    def test_unknown_keys_ignored(self):
+        p = build_params_from_query({"bogus": "1", "width": "10"})
+        assert p.width == 10
+
+    def test_bad_value_raises(self):
+        with pytest.raises(ParamError):
+            build_params_from_query({"width": "nan-ish"})
+
+
+def test_parse_json_operations():
+    ops = parse_json_operations(
+        '[{"operation": "crop", "params": {"width": 300}},'
+        ' {"operation": "convert", "ignore_failure": true, "params": {"type": "webp"}}]'
+    )
+    assert len(ops) == 2
+    assert ops[0].name == "crop"
+    assert ops[0].params == {"width": 300}
+    assert ops[1].ignore_failure is True
+
+
+def test_parse_json_operations_empty():
+    assert parse_json_operations("") == []
+    assert parse_json_operations("[") == []  # len < 2 short-circuits (params.go:413)
+
+
+def test_parse_json_operations_unknown_field():
+    with pytest.raises(ParamError):
+        parse_json_operations('[{"operation": "crop", "bogus": 1}]')
+
+
+def test_tri_state_defined_tracking():
+    p = build_params_from_query({"nocrop": "false"})
+    assert p.no_crop is False
+    assert p.is_defined("no_crop")
+    p2 = build_params_from_query({})
+    assert not p2.is_defined("no_crop")
+
+
+class TestHardenedEdgeCases:
+    """Regressions for review findings: NaN/Inf, unicode digits, typed
+    pipeline JSON fields must all render as 400s, never crash."""
+
+    @pytest.mark.parametrize("bad", ["nan", "inf", "-inf", "NaN", "Infinity"])
+    def test_nan_inf_rejected(self, bad):
+        with pytest.raises(ParamError):
+            build_params_from_query({"width": bad})
+
+    def test_unicode_digit_color_is_zero(self):
+        assert parse_color("²") == (0,)  # superscript two
+        assert parse_color("٣") == (0,)  # arabic-indic three
+
+    def test_json_nan_constant_rejected(self):
+        with pytest.raises(ParamError):
+            parse_json_operations('[{"operation": "resize", "params": {"width": NaN}}]')
+
+    def test_ignore_failure_must_be_bool(self):
+        with pytest.raises(ParamError):
+            parse_json_operations('[{"operation": "resize", "ignore_failure": "false"}]')
+
+    def test_operation_name_must_be_string(self):
+        with pytest.raises(ParamError):
+            parse_json_operations('[{"operation": 5}]')
+
+    def test_float_nan_in_pipeline_params(self):
+        from imaginary_tpu.params import _coerce_int
+        with pytest.raises(ParamError):
+            _coerce_int(float("nan"))

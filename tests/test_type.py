@@ -1,0 +1,95 @@
+"""Format/MIME mapping tests (modeled on type_test.go)."""
+
+import pytest
+
+from imaginary_tpu.imgtype import (
+    ImageType,
+    determine_image_type,
+    extract_image_type_from_mime,
+    get_image_mime_type,
+    image_type,
+    is_image_mime_type_supported,
+)
+
+
+@pytest.mark.parametrize(
+    "mime,expected",
+    [
+        ("image/jpeg", "jpeg"),
+        ("/jpeg", "jpeg"),
+        ("image/png", "png"),
+        ("image/webp", "webp"),
+        ("IMAGE/JPEG", "jpeg"),
+        ("png", ""),
+        ("multipart/form-data; encoding=utf-8", "form-data"),
+        ("application/json", "json"),
+        ("image/svg+xml", "svg"),
+        ("image/svg+xml; charset=utf-8", "svg"),
+        ("image/svg", "svg"),
+        ("xml", ""),
+        ("", ""),
+    ],
+)
+def test_extract_image_type_from_mime(mime, expected):
+    assert extract_image_type_from_mime(mime) == expected
+
+
+@pytest.mark.parametrize(
+    "mime,expected",
+    [
+        ("image/jpeg", True),
+        ("image/png", True),
+        ("image/webp", True),
+        ("IMAGE/JPEG", True),
+        ("image/svg+xml", True),
+        ("image/svg+xml; charset=utf-8", True),
+        ("image/tiff", True),
+        ("application/json", False),
+        ("text/plain", False),
+        ("blah", False),
+    ],
+)
+def test_is_image_mime_type_supported(mime, expected):
+    assert is_image_mime_type_supported(mime) is expected
+
+
+@pytest.mark.parametrize(
+    "name,expected",
+    [
+        ("jpeg", ImageType.JPEG),
+        ("jpg", ImageType.JPEG),
+        ("JPG", ImageType.JPEG),
+        ("png", ImageType.PNG),
+        ("webp", ImageType.WEBP),
+        ("tiff", ImageType.TIFF),
+        ("gif", ImageType.GIF),
+        ("svg", ImageType.SVG),
+        ("pdf", ImageType.PDF),
+        ("bogus", ImageType.UNKNOWN),
+    ],
+)
+def test_image_type(name, expected):
+    assert image_type(name) is expected
+
+
+def test_get_image_mime_type():
+    assert get_image_mime_type(ImageType.PNG) == "image/png"
+    assert get_image_mime_type(ImageType.WEBP) == "image/webp"
+    assert get_image_mime_type(ImageType.SVG) == "image/svg+xml"
+    # unknown falls back to jpeg (type.go:46-60)
+    assert get_image_mime_type(ImageType.UNKNOWN) == "image/jpeg"
+    assert get_image_mime_type(ImageType.JPEG) == "image/jpeg"
+
+
+def test_determine_image_type_magic():
+    assert determine_image_type(b"\xff\xd8\xff\xe0" + b"\x00" * 16) is ImageType.JPEG
+    assert determine_image_type(b"\x89PNG\r\n\x1a\n" + b"\x00" * 16) is ImageType.PNG
+    assert determine_image_type(b"RIFF\x00\x00\x00\x00WEBPVP8 ") is ImageType.WEBP
+    assert determine_image_type(b"GIF89a" + b"\x00" * 16) is ImageType.GIF
+    assert determine_image_type(b"II*\x00" + b"\x00" * 16) is ImageType.TIFF
+    assert determine_image_type(b"%PDF-1.4") is ImageType.PDF
+    assert determine_image_type(b"<svg xmlns='http://www.w3.org/2000/svg'/>") is ImageType.SVG
+    assert determine_image_type(b"\x00\x00\x00 ftypavif") is ImageType.AVIF
+    assert determine_image_type(b"\x00\x00\x00 ftypheic") is ImageType.HEIF
+    assert determine_image_type(b"junk") is ImageType.UNKNOWN
+    assert determine_image_type(b"") is ImageType.UNKNOWN
