@@ -1,0 +1,145 @@
+"""Host codec layer: bytes <-> HWC uint8 arrays, plus metadata probing.
+
+This plays the role of bimg/libvips' codec stack (SURVEY.md section 2.12):
+decode JPEG/PNG/WEBP/TIFF/GIF into tensors for the TPU pipeline, encode the
+results back, and answer the `/info` metadata probe (image.go:56-79).
+
+Backend selection: the native C++ extension (imaginary_tpu/native, libjpeg/
+libpng/libwebp) is preferred when built; the PIL backend is the always-
+available fallback and the correctness oracle in tests.
+
+Decoding is RAW: EXIF rotation is *not* applied here — orientation is
+reported and the op planner decides (bimg applies autorotate inside the
+processing pipeline unless NoAutoRotate is set; image.go:255-265).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from imaginary_tpu.errors import ImageError, new_error
+from imaginary_tpu.imgtype import ImageType, determine_image_type
+
+
+@dataclasses.dataclass
+class DecodedImage:
+    """A decoded frame plus the source facts the pipeline needs."""
+
+    array: np.ndarray  # HWC uint8, C in {3, 4}
+    type: ImageType
+    orientation: int  # EXIF orientation 0..8 (0 = absent)
+    has_alpha: bool
+
+
+@dataclasses.dataclass
+class ImageMetadata:
+    """The `/info` contract (ref: image.go:41-50, ImageInfo JSON)."""
+
+    width: int
+    height: int
+    type: str
+    space: str
+    has_alpha: bool
+    has_profile: bool
+    channels: int
+    orientation: int
+
+    def to_dict(self) -> dict:
+        return {
+            "width": self.width,
+            "height": self.height,
+            "type": self.type,
+            "space": self.space,
+            "hasAlpha": self.has_alpha,
+            "hasProfile": self.has_profile,
+            "channels": self.channels,
+            "orientation": self.orientation,
+        }
+
+
+@dataclasses.dataclass
+class EncodeOptions:
+    """Encode-side knobs (subset of bimg.Options consumed by save paths)."""
+
+    type: ImageType = ImageType.JPEG
+    quality: int = 0  # 0 -> default 80 (README.md:571)
+    compression: int = 0  # PNG zlib level, 0 -> default 6
+    interlace: bool = False  # progressive JPEG / interlaced PNG
+    palette: bool = False  # PNG8
+    speed: int = 0  # reserved (AVIF effort in the reference)
+    strip_metadata: bool = False
+
+    def effective_quality(self) -> int:
+        q = self.quality if self.quality > 0 else 80
+        return max(1, min(q, 100))
+
+    def effective_compression(self) -> int:
+        c = self.compression if self.compression > 0 else 6
+        return max(0, min(c, 9))
+
+
+class CodecError(ImageError):
+    def __init__(self, message: str, code: int = 400):
+        super().__init__(message, code)
+
+
+def _backend():
+    """Pick the codec backend once, lazily (native if built, else PIL)."""
+    global _BACKEND
+    if _BACKEND is None:
+        try:
+            from imaginary_tpu.codecs import native_backend
+
+            if native_backend.available():
+                _BACKEND = native_backend
+            else:  # pragma: no cover - depends on build environment
+                from imaginary_tpu.codecs import pil_backend
+
+                _BACKEND = pil_backend
+        except Exception:  # pragma: no cover
+            from imaginary_tpu.codecs import pil_backend
+
+            _BACKEND = pil_backend
+    return _BACKEND
+
+
+_BACKEND = None
+
+
+def backend_name() -> str:
+    return _backend().NAME
+
+
+def decode(buf: bytes) -> DecodedImage:
+    """Decode bytes into an HWC uint8 array (C always 3 or 4).
+
+    Raises CodecError(400) for empty/undecodable input, and CodecError(406)
+    for recognized-but-undecodable formats (svg/pdf/heif/avif need optional
+    native support, matching the reference's libvips-build-dependent
+    behavior).
+    """
+    if not buf:
+        raise CodecError("Empty or unreadable image", 400)
+    t = determine_image_type(buf)
+    return _backend().decode(buf, t)
+
+
+def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
+    """Encode an HWC uint8 array. JPEG flattens alpha onto black (libvips'
+    flatten default). Raises CodecError on unsupported target types."""
+    if arr.ndim != 3 or arr.shape[2] not in (1, 3, 4):
+        raise CodecError(f"cannot encode array of shape {arr.shape}", 500)
+    if arr.dtype != np.uint8:
+        raise CodecError(f"cannot encode dtype {arr.dtype}", 500)
+    return _backend().encode(arr, opts)
+
+
+def probe(buf: bytes) -> ImageMetadata:
+    """Metadata without a full decode (ref: bimg.Metadata, image.go:57)."""
+    if not buf:
+        raise CodecError("Cannot retrieve image metadata: empty buffer", 400)
+    t = determine_image_type(buf)
+    return _backend().probe(buf, t)

@@ -1,0 +1,80 @@
+"""Native C++ codec backend shim.
+
+Wraps the `_imaginary_codecs` C extension (imaginary_tpu/native/codecs.cpp,
+built over libjpeg/libpng/libwebp) when it has been compiled; `available()`
+gates selection in codecs.__init__. Until the extension is built this module
+reports unavailable and the PIL backend serves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from imaginary_tpu.codecs import CodecError, DecodedImage, EncodeOptions, ImageMetadata
+from imaginary_tpu.imgtype import ImageType
+
+NAME = "native"
+
+try:
+    import _imaginary_codecs as _ext  # built by imaginary_tpu/native/build.py
+except ImportError:  # pragma: no cover - depends on build step
+    _ext = None
+
+
+def available() -> bool:
+    return _ext is not None
+
+
+_DECODABLE = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
+
+
+def decode(buf: bytes, t: ImageType) -> DecodedImage:
+    if t not in _DECODABLE:
+        from imaginary_tpu.codecs import pil_backend
+
+        return pil_backend.decode(buf, t)
+    try:
+        arr, orientation, has_alpha = _ext.decode(buf, t.value)
+    except Exception as e:
+        raise CodecError(f"Cannot decode image: {e}", 400) from None
+    return DecodedImage(array=np.asarray(arr), type=t, orientation=orientation, has_alpha=bool(has_alpha))
+
+
+def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
+    if opts.type not in _DECODABLE:
+        from imaginary_tpu.codecs import pil_backend
+
+        return pil_backend.encode(arr, opts)
+    try:
+        return _ext.encode(
+            np.ascontiguousarray(arr),
+            opts.type.value,
+            opts.effective_quality(),
+            opts.effective_compression(),
+            bool(opts.interlace),
+        )
+    except Exception as e:
+        raise CodecError(f"Cannot encode image: {e}", 400) from None
+
+
+def probe(buf: bytes, t: ImageType) -> ImageMetadata:
+    if t not in _DECODABLE or _ext is None or not hasattr(_ext, "probe"):
+        from imaginary_tpu.codecs import pil_backend
+
+        return pil_backend.probe(buf, t)
+    try:
+        w, h, channels, has_alpha, orientation = _ext.probe(buf, t.value)
+    except Exception:
+        from imaginary_tpu.codecs import pil_backend
+
+        return pil_backend.probe(buf, t)
+    return ImageMetadata(
+        width=w,
+        height=h,
+        type=t.value,
+        space="srgb",
+        has_alpha=bool(has_alpha),
+        has_profile=False,
+        channels=channels,
+        orientation=orientation,
+    )

@@ -1,0 +1,120 @@
+"""PIL-based codec backend (fallback + test oracle).
+
+The native C++ backend (imaginary_tpu/native) implements the same three
+functions over libjpeg/libpng/libwebp; this one covers every format PIL
+knows and is always available.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+from PIL import Image, ImageFile
+
+from imaginary_tpu.codecs import CodecError, DecodedImage, EncodeOptions, ImageMetadata
+from imaginary_tpu.imgtype import ImageType
+
+NAME = "pil"
+
+# Tolerate slightly-truncated files the way libvips' sequential access does.
+ImageFile.LOAD_TRUNCATED_IMAGES = True
+
+_DECODABLE = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP, ImageType.TIFF, ImageType.GIF}
+_MODE_SPACE = {
+    "RGB": "srgb",
+    "RGBA": "srgb",
+    "L": "b-w",
+    "LA": "b-w",
+    "1": "b-w",
+    "P": "srgb",
+    "CMYK": "cmyk",
+    "YCbCr": "srgb",
+    "I": "b-w",
+    "F": "b-w",
+}
+
+
+def _open(buf: bytes) -> Image.Image:
+    try:
+        im = Image.open(io.BytesIO(buf))
+        im.load()
+        return im
+    except Exception as e:
+        raise CodecError(f"Cannot decode image: {e}", 400) from None
+
+
+def decode(buf: bytes, t: ImageType) -> DecodedImage:
+    if t not in _DECODABLE:
+        if t in (ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF):
+            raise CodecError(f"Decoding {t.value} is not supported by this build", 406)
+        raise CodecError("Unsupported media type", 406)
+    im = _open(buf)
+    orientation = _orientation(im)
+    has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)
+    target = "RGBA" if has_alpha else "RGB"
+    if im.mode != target:
+        im = im.convert(target)
+    arr = np.asarray(im, dtype=np.uint8)
+    return DecodedImage(array=arr, type=t, orientation=orientation, has_alpha=has_alpha)
+
+
+def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
+    t = opts.type
+    if arr.shape[2] == 1:
+        im = Image.fromarray(arr[:, :, 0], mode="L")
+    else:
+        im = Image.fromarray(arr)
+    out = io.BytesIO()
+    try:
+        if t == ImageType.JPEG:
+            if im.mode == "RGBA":
+                # libvips flattens alpha onto black for JPEG output.
+                bg = Image.new("RGB", im.size, (0, 0, 0))
+                bg.paste(im, mask=im.split()[3])
+                im = bg
+            im.save(out, "JPEG", quality=opts.effective_quality(), progressive=opts.interlace)
+        elif t == ImageType.PNG:
+            if opts.palette:
+                im = im.convert("P", palette=Image.Palette.ADAPTIVE)
+            im.save(out, "PNG", compress_level=opts.effective_compression())
+        elif t == ImageType.WEBP:
+            im.save(out, "WEBP", quality=opts.effective_quality())
+        elif t == ImageType.TIFF:
+            im.save(out, "TIFF")
+        elif t == ImageType.GIF:
+            im.save(out, "GIF")
+        else:
+            raise CodecError(f"Unsupported output image format: {t.value}", 400)
+    except CodecError:
+        raise
+    except Exception as e:
+        raise CodecError(f"Cannot encode image: {e}", 400) from None
+    return out.getvalue()
+
+
+def probe(buf: bytes, t: ImageType) -> ImageMetadata:
+    if t is ImageType.SVG:
+        # PIL cannot rasterize SVG; report what the bytes tell us.
+        return ImageMetadata(0, 0, "svg", "srgb", False, False, 3, 0)
+    im = _open(buf)
+    has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)
+    channels = len(im.getbands())
+    return ImageMetadata(
+        width=im.width,
+        height=im.height,
+        type=t.value if t is not ImageType.UNKNOWN else (im.format or "unknown").lower(),
+        space=_MODE_SPACE.get(im.mode, "srgb"),
+        has_alpha=has_alpha,
+        has_profile="icc_profile" in im.info,
+        channels=channels,
+        orientation=_orientation(im),
+    )
+
+
+def _orientation(im: Image.Image) -> int:
+    try:
+        val = im.getexif().get(274, 0)  # 274 = Orientation
+        return int(val) if isinstance(val, int) and 0 <= val <= 8 else 0
+    except Exception:
+        return 0

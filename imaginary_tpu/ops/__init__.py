@@ -1,0 +1,20 @@
+"""Pure JAX pixel kernels and the host-side geometry planner.
+
+This package replaces the reference's native pixel backend (bimg -> libvips,
+SURVEY.md section 2.12) with a TPU-first design:
+
+  buckets.py   dynamic-shape bucketing ladder (pad-to-bucket)
+  stages.py    device stage kernels over batched NHWC tensors
+  plan.py      host geometry planner: ImageOptions -> stage chain,
+               reproducing bimg's dimension semantics
+  chain.py     stage chain -> ONE jit-compiled program (per chain
+               signature x bucket), the unit the executor caches
+  saliency.py  smartcrop attention model (device-side)
+  text.py      host-side text rasterization for watermarks
+
+Design notes: every request compiles down to a sequence of stages whose
+*shapes* are static (bucketed) and whose *parameters* (actual dims, scales,
+offsets, colors, sigmas) are dynamic arrays, so one compiled program serves
+every request with the same chain shape. Resize is two batched matmuls
+against on-device-computed sampling matrices (MXU work, not gathers).
+"""

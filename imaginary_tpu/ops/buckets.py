@@ -1,0 +1,34 @@
+"""Shape-bucketing ladder.
+
+Every image entering the device is padded to a bucket (H, W) from this
+ladder; jit programs are compiled per (chain signature, bucket) pair, so the
+compile cache stays small while arbitrary request shapes are served
+(SURVEY.md section 7 hard-part #1).
+
+The ladder is geometric-ish (ratio <= 1.5) so padding waste is bounded at
+~33% per axis worst case, and every rung is a multiple of 8 to line up with
+TPU tiling (f32 sublane = 8).
+"""
+
+from __future__ import annotations
+
+LADDER = (
+    8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
+    768, 1024, 1280, 1536, 2048, 2560, 3072, 4096, 6144, 8192,
+)
+
+MAX_DIM = LADDER[-1]
+
+
+def bucket_dim(n: int) -> int:
+    """Smallest rung >= n."""
+    if n <= 0:
+        return LADDER[0]
+    for rung in LADDER:
+        if n <= rung:
+            return rung
+    raise ValueError(f"dimension {n} exceeds maximum supported {MAX_DIM}")
+
+
+def bucket_shape(h: int, w: int) -> tuple:
+    return bucket_dim(h), bucket_dim(w)

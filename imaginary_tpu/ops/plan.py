@@ -1,0 +1,506 @@
+"""Host geometry planner: (operation, ImageOptions, source facts) -> stage chain.
+
+This module encodes the reference's *dimension semantics* — what bimg's
+resizer does with Width/Height/Crop/Embed/Force/Enlarge/Zoom (SURVEY.md
+section 2.12, validated against the reference's golden tests, e.g.
+image_test.go: 550x740 resize width=300 -> 300x404; nocrop=false -> 300x740;
+fit 300x300 -> 223x300) — as pure host integer math that emits device stages.
+
+All *shapes* it produces are static bucket dims (the jit cache key); all
+*values* (actual dims, scales, offsets, colors) are per-request dynamic
+params. The planner is pure Python/numpy: fully unit-testable without JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from imaginary_tpu.errors import ImageError, new_error
+from imaginary_tpu.imgtype import ImageType, image_type
+from imaginary_tpu.options import Colorspace, Extend, Gravity, ImageOptions, apply_aspect_ratio
+from imaginary_tpu.ops.buckets import MAX_DIM, bucket_dim
+from imaginary_tpu.ops.stages import (
+    BlurSpec,
+    CompositeSpec,
+    EmbedSpec,
+    ExtractSpec,
+    FlipSpec,
+    FlopSpec,
+    GraySpec,
+    SampleSpec,
+    SmartExtractSpec,
+    TransposeSpec,
+)
+
+_f32 = np.float32
+_i32 = np.int32
+
+
+def _rnd(x: float) -> int:
+    """vips-style round half away from zero (positive domain)."""
+    return int(math.floor(x + 0.5))
+
+
+@dataclasses.dataclass
+class StageInstance:
+    spec: object  # one of the frozen specs from stages.py
+    dyn: dict  # str -> numpy scalar/array for THIS image
+
+
+@dataclasses.dataclass
+class ImagePlan:
+    """Device work for one request: the chain key is (specs, in-bucket, C)."""
+
+    stages: list
+    out_h: int
+    out_w: int
+
+    def spec_key(self) -> tuple:
+        return tuple(s.spec for s in self.stages)
+
+
+class _Planner:
+    """Tracks current dims while stages accumulate."""
+
+    def __init__(self, h: int, w: int):
+        self.h, self.w = h, w
+        self.stages: list = []
+
+    def add(self, spec, **dyn):
+        self.stages.append(StageInstance(spec, dyn))
+
+    # -- primitive geometry ----------------------------------------------------
+
+    def sample(self, dst_h: int, dst_w: int, kernel: str = "lanczos3"):
+        dst_h, dst_w = max(1, dst_h), max(1, dst_w)
+        if dst_h > MAX_DIM or dst_w > MAX_DIM:
+            raise new_error("Requested dimensions are too large", 422)
+        if (dst_h, dst_w) == (self.h, self.w):
+            return
+        self.add(
+            SampleSpec(bucket_dim(dst_h), bucket_dim(dst_w), kernel),
+            dst_h=_f32(dst_h),
+            dst_w=_f32(dst_w),
+        )
+        self.h, self.w = dst_h, dst_w
+
+    def extract(self, top: int, left: int, eh: int, ew: int):
+        if eh <= 0 or ew <= 0:
+            raise new_error("extract_area: bad extract area", 400)
+        if top + eh > self.h or left + ew > self.w or top < 0 or left < 0:
+            raise new_error("extract_area: bad extract area", 400)
+        if (top, left) == (0, 0) and (eh, ew) == (self.h, self.w):
+            return
+        self.add(
+            ExtractSpec(bucket_dim(eh), bucket_dim(ew)),
+            top=_i32(top),
+            left=_i32(left),
+            new_h=_i32(eh),
+            new_w=_i32(ew),
+        )
+        self.h, self.w = eh, ew
+
+    def smart_extract(self, eh: int, ew: int):
+        self.add(
+            SmartExtractSpec(bucket_dim(eh), bucket_dim(ew)),
+            new_h=_i32(eh),
+            new_w=_i32(ew),
+        )
+        self.h, self.w = eh, ew
+
+    def embed(self, ch: int, cw: int, mode: Extend, background: tuple, channels: int):
+        if ch > MAX_DIM or cw > MAX_DIM:
+            raise new_error("Requested dimensions are too large", 422)
+        if (ch, cw) == (self.h, self.w):
+            return
+        fill = np.zeros((channels,), dtype=_f32)
+        if mode is Extend.WHITE:
+            fill[:] = 255.0
+        elif mode is Extend.BACKGROUND and background:
+            rgb = list(background[:3]) + [0] * (3 - len(background[:3]))
+            fill[:3] = rgb
+        if channels == 4:
+            fill[3] = 255.0
+        self.add(
+            EmbedSpec(bucket_dim(ch), bucket_dim(cw), mode),
+            off_y=_i32(max(0, (ch - self.h) // 2)),
+            off_x=_i32(max(0, (cw - self.w) // 2)),
+            canvas_h=_i32(ch),
+            canvas_w=_i32(cw),
+            fill=fill,
+        )
+        self.h, self.w = ch, cw
+
+    def flip(self):
+        self.add(FlipSpec())
+
+    def flop(self):
+        self.add(FlopSpec())
+
+    def transpose(self):
+        self.add(TransposeSpec())
+        self.h, self.w = self.w, self.h
+
+    def rotate(self, angle: int):
+        """Exact 90-degree-family rotation; angle is degrees clockwise."""
+        angle = angle % 360
+        if angle == 90:
+            self.transpose()
+            self.flop()
+        elif angle == 180:
+            self.flip()
+            self.flop()
+        elif angle == 270:
+            self.transpose()
+            self.flip()
+        # other angles: not a 90-multiple; vips_rot supports only D90 family
+        # (arbitrary-angle similarity is a later milestone)
+
+    def exif_orient(self, orientation: int):
+        """EXIF orientation -> upright (ref: image.go:155-179 table)."""
+        if orientation == 2:
+            self.flop()
+        elif orientation == 3:
+            self.flip()
+            self.flop()
+        elif orientation == 4:
+            self.flip()
+        elif orientation == 5:
+            self.transpose()
+        elif orientation == 6:
+            self.transpose()
+            self.flop()
+        elif orientation == 7:
+            self.transpose()
+            self.flip()
+            self.flop()
+        elif orientation == 8:
+            self.transpose()
+            self.flip()
+
+
+# --- bimg-equivalent resize resolution ---------------------------------------
+
+def _resolve_resize(p: _Planner, o: ImageOptions, *, force: bool, crop: bool,
+                    embed: bool, enlarge: bool, channels: int):
+    """The heart of bimg's dimension semantics (see module docstring)."""
+    width, height = apply_aspect_ratio(o)
+    if width == 0 and height == 0:
+        return
+    cur_w, cur_h = p.w, p.h
+
+    if force:
+        p.sample(height or cur_h, width or cur_w)
+        return
+
+    if crop:
+        tw = width or cur_w
+        th = height or cur_h
+        scale = max(tw / cur_w, th / cur_h)
+        if scale > 1.0 and not enlarge:
+            scale = 1.0
+        rw, rh = max(1, _rnd(cur_w * scale)), max(1, _rnd(cur_h * scale))
+        p.sample(rh, rw)
+        ew, eh = min(tw, rw), min(th, rh)
+        if o.gravity is Gravity.SMART:
+            p.smart_extract(eh, ew)
+        else:
+            top, left = _gravity_offsets(o.gravity, rh, rw, eh, ew)
+            p.extract(top, left, eh, ew)
+        return
+
+    if embed:
+        if width and height:
+            scale = min(width / cur_w, height / cur_h)
+        elif width:
+            scale = width / cur_w
+        else:
+            scale = height / cur_h
+        if scale > 1.0 and not enlarge:
+            scale = 1.0
+        rw, rh = max(1, _rnd(cur_w * scale)), max(1, _rnd(cur_h * scale))
+        p.sample(rh, rw)
+        cw, ch = (width or rw), (height or rh)
+        if (cw, ch) != (rw, rh):
+            p.embed(ch, cw, o.extend, o.background, channels)
+        return
+
+    # plain path: both dims force exact (bimg normalization); one dim scales
+    if width and height:
+        p.sample(height, width)
+        return
+    scale = (width / cur_w) if width else (height / cur_h)
+    if scale > 1.0 and not enlarge:
+        scale = 1.0
+    p.sample(max(1, _rnd(cur_h * scale)), max(1, _rnd(cur_w * scale)))
+
+
+def _gravity_offsets(g: Gravity, rh: int, rw: int, eh: int, ew: int) -> tuple:
+    """Window placement for non-smart gravities (ref: params.go:439-453)."""
+    cy, cx = (rh - eh) // 2, (rw - ew) // 2
+    if g is Gravity.NORTH:
+        return 0, cx
+    if g is Gravity.SOUTH:
+        return rh - eh, cx
+    if g is Gravity.WEST:
+        return cy, 0
+    if g is Gravity.EAST:
+        return cy, rw - ew
+    return cy, cx
+
+
+# --- shared transform pipeline (the Process() equivalent) ---------------------
+
+def _common_prelude(p: _Planner, o: ImageOptions, orientation: int):
+    """EXIF autorotate + explicit rotate + flip flags (applied by every op
+    that funnels through Process; ref: bimg rotateAndFlipImage)."""
+    if not o.no_rotation and orientation > 1:
+        p.exif_orient(orientation)
+    if o.rotate:
+        p.rotate(o.rotate)
+    if o.flip:
+        p.flip()
+    if o.flop:
+        p.flop()
+
+
+def _common_postlude(p: _Planner, o: ImageOptions, channels: int):
+    """Blur + colorspace, applied to every Process()-routed op
+    (ref: options.go:164-169 GaussianBlur hook; Interpretation)."""
+    if o.sigma > 0 or o.min_ampl > 0:
+        p.add(BlurSpec(_blur_radius(o.sigma, o.min_ampl)), sigma=_f32(o.sigma))
+    if o.colorspace is Colorspace.BW:
+        p.add(GraySpec())
+
+
+def _blur_radius(sigma: float, min_ampl: float) -> int:
+    """libvips gaussmat radius: ceil(sigma * sqrt(-2 ln(min_ampl))),
+    default min_ampl 0.2; bucketed so radius stays a small static set."""
+    ma = min_ampl if 0 < min_ampl < 1 else 0.2
+    r = max(1, math.ceil(max(sigma, 0.5) * math.sqrt(-2.0 * math.log(ma))))
+    for rung in (2, 4, 8, 16, 32, 64):
+        if r <= rung:
+            return rung
+    return 64
+
+
+# --- per-operation planners (ref: image.go:115-410) ---------------------------
+
+def _require(cond: bool, msg: str):
+    if not cond:
+        raise new_error(msg, 400)
+
+
+def plan_resize(p, o, channels):
+    _require(o.width != 0 or o.height != 0, "Missing required param: height or width")
+    crop = False
+    if o.is_defined("no_crop"):
+        crop = not o.no_crop
+    _resolve_resize(p, o, force=o.force, crop=crop, embed=not crop,
+                    enlarge=False, channels=channels)
+
+
+def plan_fit(p, o, channels):
+    _require(o.width != 0 and o.height != 0, "Missing required params: height, width")
+    # fit box computed against the *oriented* dims (image.go:155-185)
+    fw, fh = _fit_dims(p.w, p.h, o.width, o.height)
+    fitted = dataclasses.replace(o, width=fw, height=fh, aspect_ratio="")
+    fitted.defined = o.defined
+    _resolve_resize(p, fitted, force=o.force, crop=False, embed=True, enlarge=False,
+                    channels=channels)
+
+
+def _fit_dims(image_w: int, image_h: int, fit_w: int, fit_h: int) -> tuple:
+    """ref: calculateDestinationFitDimension, image.go:190-200."""
+    if image_w * fit_h > fit_w * image_h:
+        fit_h = round(fit_w * image_h / image_w)  # constrained by width
+    else:
+        fit_w = round(fit_h * image_w / image_h)  # constrained by height
+    return fit_w, fit_h
+
+
+def plan_enlarge(p, o, channels):
+    _require(o.width != 0 and o.height != 0, "Missing required params: height, width")
+    _resolve_resize(p, o, force=o.force, crop=not o.no_crop, embed=o.embed,
+                    enlarge=True, channels=channels)
+
+
+def plan_extract(p, o, channels):
+    _require(o.area_width != 0 and o.area_height != 0,
+             "Missing required params: areawidth or areaheight")
+    p.extract(o.top, o.left, o.area_height, o.area_width)
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_crop(p, o, channels):
+    _require(o.width != 0 or o.height != 0, "Missing required param: height or width")
+    _resolve_resize(p, o, force=o.force, crop=True, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_smartcrop(p, o, channels):
+    _require(o.width != 0 or o.height != 0, "Missing required param: height or width")
+    smart = dataclasses.replace(o, gravity=Gravity.SMART)
+    smart.defined = o.defined
+    _resolve_resize(p, smart, force=o.force, crop=True, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_rotate(p, o, channels):
+    _require(o.rotate != 0, "Missing required param: rotate")
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_autorotate(p, o, channels):
+    # handled entirely by the prelude's EXIF stages (image.go:255-265)
+    pass
+
+
+def plan_flip(p, o, channels):
+    p.flip()
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_flop(p, o, channels):
+    p.flop()
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_thumbnail(p, o, channels):
+    _require(o.width != 0 or o.height != 0, "Missing required params: width or height")
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_zoom(p, o, channels):
+    _require(o.factor != 0, "Missing required param: factor")
+    _require(o.factor > 0, "Invalid zoom factor")
+    if o.top > 0 or o.left > 0:
+        _require(o.area_width != 0 or o.area_height != 0,
+                 "Missing required params: areawidth, areaheight")
+        p.extract(o.top, o.left, o.area_height or p.h, o.area_width or p.w)
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+    # vips_zoom replicates pixels: factor x dims, nearest kernel
+    p.sample(p.h * o.factor, p.w * o.factor, kernel="nearest")
+
+
+def plan_convert(p, o, channels):
+    _require(o.type != "", "Missing required param: type")
+    if image_type(o.type) is ImageType.UNKNOWN:
+        raise new_error("Invalid image type: " + o.type, 400)
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+
+
+def plan_blur(p, o, channels):
+    _require(o.sigma != 0 or o.min_ampl != 0, "Missing required param: sigma or minampl")
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+    # the blur itself is added by the postlude
+
+
+def plan_watermark(p, o, channels):
+    _require(o.text != "", "Missing required param: text")
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+    from imaginary_tpu.ops.text import rasterize_text
+
+    block = rasterize_text(
+        text=o.text,
+        font=o.font,
+        dpi=o.dpi,
+        text_width=o.text_width or (p.w // 2),
+        color=o.color,
+        max_w=max(8, p.w),
+        max_h=max(8, p.h),
+    )
+    bh, bw = block.shape[0], block.shape[1]
+    margin = max(0, o.margin)
+    opacity = o.opacity if o.opacity > 0 else 0.25  # bimg watermark default
+    p.add(
+        CompositeSpec(bucket_dim(bh), bucket_dim(bw), replicate=not o.no_replicate),
+        overlay=_pad_block(block, bucket_dim(bh), bucket_dim(bw)),
+        top=_i32(min(margin, max(0, p.h - 1))),
+        left=_i32(min(margin, max(0, p.w - 1))),
+        opacity=_f32(opacity),
+        block_h=_i32(bh),
+        block_w=_i32(bw),
+    )
+
+
+def plan_watermark_image(p, o, channels, watermark_rgba: Optional[np.ndarray] = None):
+    _require(o.image != "", "Missing required param: image")
+    _resolve_resize(p, o, force=o.force, crop=False, embed=o.embed, enlarge=False,
+                    channels=channels)
+    if watermark_rgba is None:
+        raise new_error("Unable to retrieve watermark image: " + o.image, 400)
+    bh = min(watermark_rgba.shape[0], p.h)
+    bw = min(watermark_rgba.shape[1], p.w)
+    block = watermark_rgba[:bh, :bw]
+    opacity = o.opacity if o.opacity > 0 else 1.0
+    p.add(
+        CompositeSpec(bucket_dim(bh), bucket_dim(bw), replicate=False),
+        overlay=_pad_block(block, bucket_dim(bh), bucket_dim(bw)),
+        top=_i32(max(0, min(o.top, p.h - bh))),
+        left=_i32(max(0, min(o.left, p.w - bw))),
+        opacity=_f32(opacity),
+        block_h=_i32(bh),
+        block_w=_i32(bw),
+    )
+
+
+def _pad_block(block: np.ndarray, hb: int, wb: int) -> np.ndarray:
+    out = np.zeros((hb, wb, 4), dtype=_f32)
+    out[: block.shape[0], : block.shape[1], :] = block.astype(_f32)
+    return out
+
+
+_PLANNERS = {
+    "resize": plan_resize,
+    "fit": plan_fit,
+    "enlarge": plan_enlarge,
+    "extract": plan_extract,
+    "crop": plan_crop,
+    "smartcrop": plan_smartcrop,
+    "rotate": plan_rotate,
+    "autorotate": plan_autorotate,
+    "flip": plan_flip,
+    "flop": plan_flop,
+    "thumbnail": plan_thumbnail,
+    "zoom": plan_zoom,
+    "convert": plan_convert,
+    "blur": plan_blur,
+    "watermark": plan_watermark,
+    "watermarkImage": plan_watermark_image,
+}
+
+OPERATION_NAMES = tuple(_PLANNERS)
+
+
+def plan_operation(name: str, o: ImageOptions, src_h: int, src_w: int,
+                   orientation: int, channels: int,
+                   watermark_rgba: Optional[np.ndarray] = None) -> ImagePlan:
+    """Build the device plan for one operation (ref: OperationsMap,
+    image.go:15-32). Raises ImageError(400) for validation failures,
+    matching each op's required-param checks."""
+    if name not in _PLANNERS:
+        raise new_error(f"Unsupported operation: {name}", 400)
+    if src_h <= 0 or src_w <= 0:
+        raise new_error("Width or height of requested image is zero", 406)
+    p = _Planner(src_h, src_w)
+    _common_prelude(p, o, orientation)
+    if name == "watermarkImage":
+        plan_watermark_image(p, o, channels, watermark_rgba)
+    else:
+        _PLANNERS[name](p, o, channels)
+    _common_postlude(p, o, channels)
+    return ImagePlan(stages=p.stages, out_h=p.h, out_w=p.w)

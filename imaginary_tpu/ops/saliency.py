@@ -1,0 +1,69 @@
+"""Smartcrop saliency: device-side attention model.
+
+Reimplements the *behavior* of libvips' smartcrop "attention" strategy
+(ref: bimg GravitySmart, image.go:236-245; libvips interesting=attention):
+score pixels by edge energy, colour saturation, and skin-tone likelihood,
+then place the crop window over the highest-scoring region.
+
+TPU-first formulation: saliency is elementwise math + shifted differences,
+the window search is an integral-image (2-D cumsum) evaluated at every
+candidate offset with one argmax — no data-dependent loops, fully jittable
+with dynamic window sizes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _saliency_map(x: jnp.ndarray, h: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W] non-negative saliency, zero outside the valid region."""
+    rgb = x[..., :3] / 255.0
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    lum = 0.2126 * r + 0.7152 * g + 0.0722 * b
+
+    # edge energy: central differences, edge-replicated
+    pad_y = jnp.pad(lum, ((0, 0), (1, 1), (0, 0)), mode="edge")
+    pad_x = jnp.pad(lum, ((0, 0), (0, 0), (1, 1)), mode="edge")
+    dy = jnp.abs(pad_y[:, 2:, :] - pad_y[:, :-2, :])
+    dx = jnp.abs(pad_x[:, :, 2:] - pad_x[:, :, :-2])
+    edges = dx + dy
+
+    # saturation
+    sat = jnp.max(rgb, axis=-1) - jnp.min(rgb, axis=-1)
+
+    # skin-tone likelihood (gaussian around a canonical skin chroma)
+    skin = jnp.exp(-(((r - 0.78) ** 2) + ((g - 0.57) ** 2) + ((b - 0.44) ** 2)) / 0.025)
+
+    sal = 4.0 * edges + 1.0 * sat + 1.5 * skin
+
+    hb, wb = x.shape[1], x.shape[2]
+    ys = jnp.arange(hb, dtype=jnp.int32)
+    xs = jnp.arange(wb, dtype=jnp.int32)
+    valid = (ys[None, :, None] < h[:, None, None]) & (xs[None, None, :] < w[:, None, None])
+    return jnp.where(valid, sal, 0.0)
+
+
+def smart_offsets(x: jnp.ndarray, h: jnp.ndarray, w: jnp.ndarray,
+                  win_h: jnp.ndarray, win_w: jnp.ndarray):
+    """Best (top, left) per batch element for a (win_h, win_w) crop window."""
+    sal = _saliency_map(x, h, w)
+    hb, wb = sal.shape[1], sal.shape[2]
+    ii = jnp.pad(jnp.cumsum(jnp.cumsum(sal, axis=1), axis=2), ((0, 0), (1, 0), (1, 0)))
+
+    def one(ii1, hh, ww, wh, wl):
+        tops = jnp.arange(hb, dtype=jnp.int32)
+        lefts = jnp.arange(wb, dtype=jnp.int32)
+        bot = jnp.clip(tops + wh, 0, hb)
+        right = jnp.clip(lefts + wl, 0, wb)
+        # window sum S[t, l] = ii[bot, right] - ii[t, right] - ii[bot, l] + ii[t, l]
+        rb = ii1[bot]      # [hb, wb+1]
+        rt = ii1[tops]     # [hb, wb+1]
+        s = (rb[:, right] - rt[:, right]) - (rb[:, lefts] - rt[:, lefts])
+        ok = (tops[:, None] <= hh - wh) & (lefts[None, :] <= ww - wl)
+        s = jnp.where(ok, s, -1.0)
+        i = jnp.argmax(s)
+        return (i // wb).astype(jnp.int32), (i % wb).astype(jnp.int32)
+
+    return jax.vmap(one)(ii, h, w, win_h, win_w)

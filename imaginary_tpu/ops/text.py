@@ -1,0 +1,67 @@
+"""Host-side text rasterization for text watermarks.
+
+The reference renders text via libvips/pango (image.go:322-341,
+opts.Watermark fields). Rasterization is inherently host work; the rendered
+RGBA block ships to device where CompositeSpec alpha-blends (and optionally
+tiles) it. PIL's bitmap font engine plays pango's role.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+from PIL import Image, ImageDraw, ImageFont
+
+_DEFAULT_POINT = 12.0
+
+
+@functools.lru_cache(maxsize=64)
+def _load_font(spec: str, dpi: int):
+    """`"sans 12"` style font spec (ref README watermark `font` param)."""
+    size = _DEFAULT_POINT
+    if spec:
+        parts = spec.rsplit(" ", 1)
+        if len(parts) == 2:
+            try:
+                size = float(parts[1])
+            except ValueError:
+                pass
+    px = max(6, int(round(size * (dpi or 72) / 72.0)))
+    try:
+        return ImageFont.load_default(size=px)
+    except Exception:  # pragma: no cover - ancient PIL
+        return ImageFont.load_default()
+
+
+def rasterize_text(text: str, font: str, dpi: int, text_width: int,
+                   color: tuple, max_w: int, max_h: int) -> np.ndarray:
+    """Render text to an RGBA uint8 block, word-wrapped to text_width px."""
+    fnt = _load_font(font or "sans 12", dpi or 72)
+    text_width = max(16, min(text_width or max_w, max_w))
+
+    # word-wrap with a probe draw
+    probe = ImageDraw.Draw(Image.new("RGBA", (8, 8)))
+    lines, line = [], ""
+    for word in text.split():
+        cand = (line + " " + word).strip()
+        if probe.textlength(cand, font=fnt) <= text_width or not line:
+            line = cand
+        else:
+            lines.append(line)
+            line = word
+    if line:
+        lines.append(line)
+    if not lines:
+        lines = [""]
+
+    asc, desc = fnt.getmetrics() if hasattr(fnt, "getmetrics") else (12, 4)
+    lh = asc + desc + 2
+    bw = int(min(max_w, max(probe.textlength(ln, font=fnt) for ln in lines) + 4))
+    bh = int(min(max_h, lh * len(lines) + 4))
+    img = Image.new("RGBA", (max(bw, 8), max(bh, 8)), (0, 0, 0, 0))
+    draw = ImageDraw.Draw(img)
+    rgb = tuple(int(c) for c in color[:3]) if len(color) >= 3 else (255, 255, 255)
+    for i, ln in enumerate(lines):
+        draw.text((2, 2 + i * lh), ln, font=fnt, fill=rgb + (255,))
+    return np.asarray(img, dtype=np.uint8)

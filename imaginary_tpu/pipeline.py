@@ -1,0 +1,193 @@
+"""Operation registry and the synchronous processing path.
+
+This is the role of image.go: the 16 named transforms + `info` + `pipeline`,
+all funnelling into one processing core. Where the reference's core is a
+per-request cgo call into libvips (image.go:81-113), ours is: host decode ->
+geometry plan -> ONE jit-compiled device program -> host encode. A JSON
+/pipeline fuses every stage of every op into that single program — decode
+once, encode once — where the reference pays a full decode+encode per op
+(SURVEY.md section 3.3).
+
+The async micro-batching executor (engine/) reuses exactly these plans;
+this module is the single-image path used by tests and CLI tools.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Optional
+
+import numpy as np
+
+from imaginary_tpu import codecs
+from imaginary_tpu.codecs import EncodeOptions
+from imaginary_tpu.errors import ImageError, new_error
+from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.params import build_params_from_operation
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.plan import OPERATION_NAMES, ImagePlan, plan_operation
+
+# Ops servable over HTTP (ref: OperationsMap image.go:15-32 + /info + /pipeline)
+ALL_OPERATIONS = OPERATION_NAMES + ("info", "pipeline")
+
+MAX_PIPELINE_OPERATIONS = 10  # ref: image.go:383-385
+
+# Injected by the web layer: url -> RGBA ndarray (watermarkimage fetch,
+# image.go:343-370). Kept injectable so the ops layer stays network-free.
+WatermarkFetcher = Callable[[str], np.ndarray]
+
+
+@dataclasses.dataclass
+class ProcessedImage:
+    body: bytes
+    mime: str
+
+
+def _encode_type(o: ImageOptions, source: ImageType) -> ImageType:
+    """Output format resolution (ref: Process type handling + type.go)."""
+    from imaginary_tpu.imgtype import ENCODABLE
+
+    if o.type and o.type != "auto":
+        t = image_type(o.type)
+        if t is ImageType.UNKNOWN:
+            raise new_error("Unsupported output image format", 400)
+        return t
+    # no explicit type: keep source format where encodable, else JPEG
+    return source if source in ENCODABLE else ImageType.JPEG
+
+
+def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedImage:
+    """Encode with the WEBP/HEIF/AVIF -> JPEG fallback (image.go:99-103)."""
+    opts = EncodeOptions(
+        type=target,
+        quality=o.quality,
+        compression=o.compression,
+        interlace=o.interlace,
+        palette=o.palette,
+        speed=o.speed,
+        strip_metadata=o.strip_metadata,
+    )
+    try:
+        body = codecs.encode(arr, opts)
+        actual = target
+    except ImageError:
+        if target in (ImageType.WEBP, ImageType.HEIF, ImageType.AVIF):
+            opts.type = ImageType.JPEG
+            body = codecs.encode(arr, opts)
+            actual = ImageType.JPEG
+        else:
+            raise
+    return ProcessedImage(body=body, mime=get_image_mime_type(actual))
+
+
+def _run_stages(arr: np.ndarray, plan: ImagePlan) -> np.ndarray:
+    """Device execution with the panic guard (ref: Process recover(),
+    image.go:82-94): backend failures surface as 400s, not 500s."""
+    if not plan.stages:
+        return arr
+    try:
+        return chain_mod.run_single(arr, plan)
+    except ImageError:
+        raise
+    except Exception as e:  # XLA/compile/runtime errors
+        raise new_error(f"image processing error: {e}", 400) from None
+
+
+def info(buf: bytes, o: ImageOptions) -> ProcessedImage:
+    """ref: Info, image.go:56-79."""
+    try:
+        meta = codecs.probe(buf)
+    except ImageError as e:
+        raise new_error("Cannot retrieve image metadata: " + e.message, 400) from None
+    return ProcessedImage(body=json.dumps(meta.to_dict()).encode(), mime="application/json")
+
+
+def process_operation(
+    name: str,
+    buf: bytes,
+    o: ImageOptions,
+    watermark_fetcher: Optional[WatermarkFetcher] = None,
+) -> ProcessedImage:
+    """Run one named operation end-to-end (decode -> device -> encode)."""
+    if name == "info":
+        return info(buf, o)
+    if name == "pipeline":
+        return process_pipeline(buf, o, watermark_fetcher)
+    if name not in OPERATION_NAMES:
+        raise new_error(f"Unsupported operation: {name}", 400)
+
+    d = codecs.decode(buf)
+    wm = _fetch_watermark(name, o, watermark_fetcher)
+    plan = plan_operation(
+        name, o, d.array.shape[0], d.array.shape[1], d.orientation,
+        d.array.shape[2], watermark_rgba=wm,
+    )
+    arr = _run_stages(d.array, plan)
+    return _encode(arr, o, _encode_type(o, d.type))
+
+
+def process_pipeline(
+    buf: bytes,
+    o: ImageOptions,
+    watermark_fetcher: Optional[WatermarkFetcher] = None,
+) -> ProcessedImage:
+    """Fused multi-op pipeline (ref: Pipeline, image.go:379-410).
+
+    All ops' stages concatenate into ONE device program; `ignore_failure`
+    skips an op whose planning fails (the reference skips ops whose
+    execution fails — planning is where our validation happens).
+    """
+    if not o.operations:
+        raise new_error("Missing pipeline operations", 400)
+    if len(o.operations) > MAX_PIPELINE_OPERATIONS:
+        raise new_error(f"Maximum pipeline operations ({MAX_PIPELINE_OPERATIONS}) exceeded", 400)
+
+    d = codecs.decode(buf)
+    cur_h, cur_w = d.array.shape[0], d.array.shape[1]
+    orientation = d.orientation
+    channels = d.array.shape[2]
+    stages: list = []
+    final_o = o
+    target = _encode_type(o, d.type)
+
+    for i, op in enumerate(o.operations):
+        if op.name not in OPERATION_NAMES:  # info/pipeline are not nestable
+            raise new_error(f"Unsupported operation: {op.name}", 400)
+        try:
+            op_opts = build_params_from_operation(op)
+        except Exception as e:
+            raise new_error(f"pipeline operation {i+1} failed: {e}", 400) from None
+        try:
+            wm = _fetch_watermark(op.name, op_opts, watermark_fetcher)
+            plan = plan_operation(
+                op.name, op_opts, cur_h, cur_w, orientation, channels, watermark_rgba=wm
+            )
+        except ImageError:
+            if op.ignore_failure:
+                continue
+            raise
+        stages.extend(plan.stages)
+        cur_h, cur_w = plan.out_h, plan.out_w
+        orientation = 0  # EXIF applies once; later ops see upright pixels
+        final_o = op_opts
+        if op_opts.type:
+            target = _encode_type(op_opts, d.type)
+
+    combined = ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w)
+    arr = _run_stages(d.array, combined)
+    return _encode(arr, final_o, target)
+
+
+def _fetch_watermark(name, o, fetcher) -> Optional[np.ndarray]:
+    if name != "watermarkImage" or not o.image:
+        return None
+    if fetcher is None:
+        raise new_error("Unable to retrieve watermark image: " + o.image, 400)
+    try:
+        return fetcher(o.image)
+    except ImageError:
+        raise
+    except Exception:
+        raise new_error("Unable to retrieve watermark image: " + o.image, 400) from None

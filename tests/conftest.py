@@ -13,6 +13,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment's TPU-tunnel plugin (axon) may have force-registered itself
+# at interpreter boot and set jax_platforms="axon,cpu"; re-pin to pure CPU
+# before any backend is instantiated so tests never touch (or hang on) the
+# tunnel. Safe even when jax was already imported: backends init lazily.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 # Make the repo root importable when pytest is run from anywhere.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:

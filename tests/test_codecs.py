@@ -1,0 +1,121 @@
+"""Codec layer tests: round-trips, metadata probe, error paths.
+
+PIL (via independent re-open) is the oracle for encoded outputs, mirroring
+how the reference asserts via bimg.NewImage(buf).Size() (server_test.go:
+424-433)."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu import codecs
+from imaginary_tpu.codecs import CodecError, EncodeOptions
+from imaginary_tpu.imgtype import ImageType
+from tests.conftest import fixture_bytes
+
+
+def _oracle_size(buf: bytes):
+    im = Image.open(io.BytesIO(buf))
+    return im.width, im.height
+
+
+class TestDecode:
+    def test_jpeg(self, testdata):
+        d = codecs.decode(fixture_bytes("imaginary.jpg"))
+        assert d.type is ImageType.JPEG
+        assert d.array.shape == (740, 550, 3)
+        assert d.array.dtype == np.uint8
+        assert not d.has_alpha
+
+    def test_png(self, testdata):
+        d = codecs.decode(fixture_bytes("test.png"))
+        assert d.type is ImageType.PNG
+        assert d.array.shape[:2] == (512, 512)
+
+    def test_webp(self, testdata):
+        d = codecs.decode(fixture_bytes("test.webp"))
+        assert d.type is ImageType.WEBP
+        assert d.array.shape[:2] == (512, 512)
+
+    def test_gif(self, testdata):
+        d = codecs.decode(fixture_bytes("test.gif"))
+        assert d.type is ImageType.GIF
+        assert d.array.shape[:2] == (240, 320)
+
+    def test_exif_orientation_reported_not_applied(self, testdata):
+        d = codecs.decode(fixture_bytes("exif-orient-6.jpg"))
+        assert d.orientation == 6
+        # raw sensor dims, rotation NOT applied at decode time
+        assert d.array.shape[:2] == (300, 400)
+
+    def test_empty_raises_400(self):
+        with pytest.raises(CodecError) as e:
+            codecs.decode(b"")
+        assert e.value.http_code() == 400
+
+    def test_garbage_raises(self):
+        with pytest.raises(CodecError):
+            codecs.decode(b"this is not an image at all")
+
+    def test_svg_unsupported_406(self):
+        with pytest.raises(CodecError) as e:
+            codecs.decode(b"<svg xmlns='http://www.w3.org/2000/svg' width='10' height='10'/>")
+        assert e.value.http_code() == 406
+
+
+class TestEncode:
+    @pytest.mark.parametrize("t", [ImageType.JPEG, ImageType.PNG, ImageType.WEBP, ImageType.TIFF, ImageType.GIF])
+    def test_roundtrip(self, t):
+        arr = np.linspace(0, 255, 64 * 48 * 3, dtype=np.uint8).reshape(48, 64, 3)
+        buf = codecs.encode(arr, EncodeOptions(type=t))
+        assert _oracle_size(buf) == (64, 48)
+
+    def test_jpeg_flattens_alpha(self):
+        arr = np.zeros((10, 10, 4), dtype=np.uint8)
+        arr[..., 0] = 255  # red, fully transparent
+        buf = codecs.encode(arr, EncodeOptions(type=ImageType.JPEG))
+        back = np.asarray(Image.open(io.BytesIO(buf)).convert("RGB"))
+        # transparent red over black -> black
+        assert back.mean() < 10
+
+    def test_quality_changes_size(self):
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 256, (256, 256, 3), dtype=np.uint8)
+        hi = codecs.encode(arr, EncodeOptions(type=ImageType.JPEG, quality=95))
+        lo = codecs.encode(arr, EncodeOptions(type=ImageType.JPEG, quality=10))
+        assert len(lo) < len(hi)
+
+    def test_unsupported_type(self):
+        arr = np.zeros((4, 4, 3), dtype=np.uint8)
+        with pytest.raises(CodecError):
+            codecs.encode(arr, EncodeOptions(type=ImageType.PDF))
+
+    def test_bad_shape_rejected(self):
+        with pytest.raises(CodecError):
+            codecs.encode(np.zeros((4, 4), dtype=np.uint8), EncodeOptions())
+        with pytest.raises(CodecError):
+            codecs.encode(np.zeros((4, 4, 3), dtype=np.float32), EncodeOptions())
+
+
+class TestProbe:
+    def test_info_contract(self, testdata):
+        m = codecs.probe(fixture_bytes("imaginary.jpg"))
+        d = m.to_dict()
+        assert d["width"] == 550 and d["height"] == 740
+        assert d["type"] == "jpeg"
+        assert d["channels"] == 3
+        assert d["hasAlpha"] is False
+        assert set(d) == {
+            "width", "height", "type", "space", "hasAlpha",
+            "hasProfile", "channels", "orientation",
+        }
+
+    def test_probe_orientation(self, testdata):
+        m = codecs.probe(fixture_bytes("exif-orient-6.jpg"))
+        assert m.orientation == 6
+
+    def test_probe_empty(self):
+        with pytest.raises(CodecError):
+            codecs.probe(b"")

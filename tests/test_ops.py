@@ -1,0 +1,353 @@
+"""Operation dimension/MIME golden tests.
+
+Mirrors the reference's operation tests (image_test.go) on the same fixture
+dimensions: imaginary.jpg is 550x740. PIL is the independent oracle for
+output size and format, as bimg.NewImage(buf).Size() is upstream
+(server_test.go:424-433).
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu.errors import ImageError
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.params import build_params_from_query, parse_json_operations
+from imaginary_tpu.pipeline import process_operation, process_pipeline
+from tests.conftest import fixture_bytes
+
+
+def oracle(img_bytes):
+    im = Image.open(io.BytesIO(img_bytes))
+    return im.width, im.height, (im.format or "").lower()
+
+
+@pytest.fixture(scope="module")
+def jpg(testdata):
+    return fixture_bytes("imaginary.jpg")
+
+
+class TestResize:
+    def test_width_and_height(self, jpg):
+        out = process_operation("resize", jpg, ImageOptions(width=300, height=300))
+        assert out.mime == "image/jpeg"
+        assert oracle(out.body)[:2] == (300, 300)
+
+    def test_width_only(self, jpg):
+        out = process_operation("resize", jpg, ImageOptions(width=300))
+        # 550x740 -> 300x404 (image_test.go:37)
+        assert oracle(out.body)[:2] == (300, 404)
+
+    def test_width_nocrop_false(self, jpg):
+        o = ImageOptions(width=300, no_crop=False)
+        o.mark_defined("no_crop")
+        out = process_operation("resize", jpg, o)
+        # crop path keeps original height (image_test.go:54)
+        assert oracle(out.body)[:2] == (300, 740)
+
+    def test_width_nocrop_true(self, jpg):
+        o = ImageOptions(width=300, no_crop=True)
+        o.mark_defined("no_crop")
+        out = process_operation("resize", jpg, o)
+        assert oracle(out.body)[:2] == (300, 404)
+
+    def test_missing_params(self, jpg):
+        with pytest.raises(ImageError) as e:
+            process_operation("resize", jpg, ImageOptions())
+        assert e.value.http_code() == 400
+
+
+class TestFit:
+    def test_fit(self, jpg):
+        out = process_operation("fit", jpg, ImageOptions(width=300, height=300))
+        # 550x740 -> 223x300 (image_test.go:88)
+        assert oracle(out.body)[:2] == (223, 300)
+
+    def test_fit_requires_both(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("fit", jpg, ImageOptions(width=300))
+
+
+class TestCropFamily:
+    def test_crop(self, jpg):
+        out = process_operation("crop", jpg, ImageOptions(width=200, height=120))
+        assert oracle(out.body)[:2] == (200, 120)
+
+    def test_crop_upscale_clamped(self, jpg):
+        # crop larger than source without enlarge: window clamps to source
+        out = process_operation("crop", jpg, ImageOptions(width=2000, height=100))
+        assert oracle(out.body)[:2] == (550, 100)
+
+    def test_enlarge(self, jpg):
+        out = process_operation("enlarge", jpg, ImageOptions(width=1100, height=1480))
+        assert oracle(out.body)[:2] == (1100, 1480)
+
+    def test_extract(self, jpg):
+        out = process_operation(
+            "extract", jpg, ImageOptions(top=10, left=10, area_width=200, area_height=120)
+        )
+        assert oracle(out.body)[:2] == (200, 120)
+
+    def test_extract_out_of_bounds(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation(
+                "extract", jpg, ImageOptions(top=700, left=0, area_width=200, area_height=120)
+            )
+
+    def test_smartcrop(self, testdata):
+        buf = fixture_bytes("smart-crop.jpg")
+        out = process_operation("smartcrop", buf, ImageOptions(width=200, height=150))
+        assert oracle(out.body)[:2] == (200, 150)
+
+    def test_smartcrop_finds_salient_region(self, testdata):
+        # fixture: flat 230-gray background, red disc centred at (600, 180)
+        buf = fixture_bytes("smart-crop.jpg")
+        out = process_operation("smartcrop", buf, ImageOptions(width=200, height=150))
+        arr = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"), dtype=np.float64)
+        # the crop must contain the red disc: strong red dominance somewhere
+        red_excess = (arr[..., 0] - arr[..., 1]).max()
+        assert red_excess > 100, "smartcrop missed the salient red disc"
+
+
+class TestRotateFlip:
+    def test_rotate_90_swaps_dims(self, jpg):
+        out = process_operation("rotate", jpg, ImageOptions(rotate=90))
+        assert oracle(out.body)[:2] == (740, 550)
+
+    def test_rotate_180_keeps_dims(self, jpg):
+        out = process_operation("rotate", jpg, ImageOptions(rotate=180))
+        assert oracle(out.body)[:2] == (550, 740)
+
+    def test_rotate_requires_param(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("rotate", jpg, ImageOptions())
+
+    def test_flip_flop_pixels(self, jpg):
+        src = np.asarray(Image.open(io.BytesIO(jpg)).convert("RGB"))
+        flipped = process_operation("flip", jpg, ImageOptions())
+        arr = np.asarray(Image.open(io.BytesIO(flipped.body)).convert("RGB"))
+        assert arr.shape == src.shape
+        # top row of flip ~ bottom row of src (JPEG tolerance)
+        assert np.mean(np.abs(arr[0].astype(int) - src[-1].astype(int))) < 20
+        flopped = process_operation("flop", jpg, ImageOptions())
+        arr2 = np.asarray(Image.open(io.BytesIO(flopped.body)).convert("RGB"))
+        assert np.mean(np.abs(arr2[:, 0].astype(int) - src[:, -1].astype(int))) < 20
+
+    def test_autorotate(self, testdata):
+        buf = fixture_bytes("exif-orient-6.jpg")
+        out = process_operation("autorotate", buf, ImageOptions())
+        # 400x300 sensor data, orientation 6 -> upright 300x400
+        assert oracle(out.body)[:2] == (300, 400)
+
+    def test_resize_applies_exif(self, testdata):
+        buf = fixture_bytes("exif-orient-6.jpg")
+        out = process_operation("resize", buf, ImageOptions(width=150))
+        # upright 300x400 resized to width 150 -> 150x200
+        assert oracle(out.body)[:2] == (150, 200)
+
+
+class TestConvertThumbnailZoom:
+    def test_convert_webp(self, jpg):
+        out = process_operation("convert", jpg, ImageOptions(type="webp"))
+        assert out.mime == "image/webp"
+        assert oracle(out.body)[2] == "webp"
+
+    def test_convert_png(self, jpg):
+        out = process_operation("convert", jpg, ImageOptions(type="png"))
+        assert out.mime == "image/png"
+
+    def test_convert_requires_type(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("convert", jpg, ImageOptions())
+
+    def test_convert_invalid_type(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("convert", jpg, ImageOptions(type="bogus"))
+
+    def test_thumbnail(self, jpg):
+        out = process_operation("thumbnail", jpg, ImageOptions(width=100))
+        assert oracle(out.body)[:2] == (100, 135)  # 740*100/550 = 134.5 -> 135
+
+    def test_zoom(self, jpg):
+        out = process_operation("zoom", jpg, ImageOptions(factor=2, width=100))
+        # resize to 100x135 then 2x replication
+        assert oracle(out.body)[:2] == (200, 270)
+
+    def test_zoom_requires_factor(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("zoom", jpg, ImageOptions())
+
+
+class TestBlurWatermark:
+    def test_blur_dims_and_effect(self, jpg):
+        # PNG output so the high-frequency check is not polluted by JPEG noise
+        out = process_operation("blur", jpg, ImageOptions(sigma=8, type="png"))
+        assert oracle(out.body)[:2] == (550, 740)
+        src = np.asarray(Image.open(io.BytesIO(jpg)).convert("RGB"), dtype=np.float64)
+        blr = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"), dtype=np.float64)
+        # independent oracle: scipy gaussian with edge-clamp semantics
+        from scipy.ndimage import gaussian_filter
+
+        ref = gaussian_filter(src, sigma=(8, 8, 0), mode="nearest")
+        assert np.abs(blr - ref).mean() < 2.0
+
+    def test_blur_requires_sigma(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("blur", jpg, ImageOptions())
+
+    def test_watermark_text(self, jpg):
+        out = process_operation(
+            "watermark", jpg, ImageOptions(text="hello", opacity=0.9)
+        )
+        assert oracle(out.body)[:2] == (550, 740)
+
+    def test_watermark_requires_text(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("watermark", jpg, ImageOptions())
+
+    def test_watermark_image(self, jpg, testdata):
+        wm = np.zeros((40, 60, 4), dtype=np.uint8)
+        wm[..., 1] = 255
+        wm[..., 3] = 255
+        out = process_operation(
+            "watermarkImage", jpg,
+            ImageOptions(image="http://example.com/wm.png", top=5, left=5, opacity=1.0),
+            watermark_fetcher=lambda url: wm,
+        )
+        assert oracle(out.body)[:2] == (550, 740)
+        arr = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"))
+        patch = arr[10:40, 10:60]
+        assert patch[..., 1].mean() > 200  # green overlay landed
+
+    def test_watermark_image_requires_url(self, jpg):
+        with pytest.raises(ImageError):
+            process_operation("watermarkImage", jpg, ImageOptions())
+
+
+class TestInfo:
+    def test_info(self, jpg):
+        out = process_operation("info", jpg, ImageOptions())
+        assert out.mime == "application/json"
+        meta = json.loads(out.body)
+        assert meta["width"] == 550 and meta["height"] == 740
+        assert meta["type"] == "jpeg"
+
+
+class TestPipeline:
+    def test_crop_then_convert(self, jpg):
+        ops = parse_json_operations(
+            '[{"operation": "crop", "params": {"width": 300, "height": 260}},'
+            ' {"operation": "convert", "params": {"type": "webp"}}]'
+        )
+        out = process_pipeline(jpg, ImageOptions(operations=ops))
+        # image_test.go:109-142: 300x260 webp
+        w, h, fmt = oracle(out.body)
+        assert (w, h, fmt) == (300, 260, "webp")
+
+    def test_pipeline_fused_chain(self, jpg):
+        ops = parse_json_operations(
+            '[{"operation": "resize", "params": {"width": 400}},'
+            ' {"operation": "blur", "params": {"sigma": 3}},'
+            ' {"operation": "crop", "params": {"width": 200, "height": 150}}]'
+        )
+        out = process_pipeline(jpg, ImageOptions(operations=ops))
+        assert oracle(out.body)[:2] == (200, 150)
+
+    def test_pipeline_limit(self, jpg):
+        ops = parse_json_operations(
+            "[" + ",".join('{"operation": "flip"}' for _ in range(11)) + "]"
+        )
+        with pytest.raises(ImageError) as e:
+            process_pipeline(jpg, ImageOptions(operations=ops))
+        assert "Maximum pipeline operations" in e.value.message
+
+    def test_pipeline_unknown_op(self, jpg):
+        ops = parse_json_operations('[{"operation": "bogus"}]')
+        with pytest.raises(ImageError):
+            process_pipeline(jpg, ImageOptions(operations=ops))
+
+    def test_pipeline_ignore_failure(self, jpg):
+        ops = parse_json_operations(
+            '[{"operation": "resize", "ignore_failure": true, "params": {}},'
+            ' {"operation": "crop", "params": {"width": 120, "height": 90}}]'
+        )
+        out = process_pipeline(jpg, ImageOptions(operations=ops))
+        assert oracle(out.body)[:2] == (120, 90)
+
+    def test_pipeline_empty(self, jpg):
+        with pytest.raises(ImageError):
+            process_pipeline(jpg, ImageOptions())
+
+
+class TestQualityAndFormats:
+    def test_resize_png_roundtrip(self, testdata):
+        buf = fixture_bytes("test.png")
+        out = process_operation("resize", buf, ImageOptions(width=100))
+        w, h, fmt = oracle(out.body)
+        assert (w, h, fmt) == (100, 100, "png")
+
+    def test_resize_content_sane(self, jpg):
+        """Downscale must look like the source (correlation check)."""
+        out = process_operation("resize", jpg, ImageOptions(width=128, height=128, force=True))
+        got = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"), dtype=np.float64)
+        ref = np.asarray(
+            Image.open(io.BytesIO(jpg)).convert("RGB").resize((128, 128), Image.LANCZOS),
+            dtype=np.float64,
+        )
+        err = np.abs(got - ref).mean()
+        assert err < 12.0, f"mean abs err vs PIL lanczos = {err:.2f}"
+
+
+class TestBucketClampRegressions:
+    """Review findings: dynamic_slice whole-window clamping must not shift
+    crops/watermarks when actual offset + bucketed size exceeds the input
+    bucket (top+eh fits but top+bucket(eh) does not)."""
+
+    def _gradient_jpgless(self, h, w):
+        # exact pixel values, encode as PNG to avoid JPEG noise
+        import io as _io
+        yy = np.arange(h, dtype=np.uint8)[:, None]
+        arr = np.repeat(np.repeat(yy, w, axis=1)[..., None], 3, axis=2)
+        b = _io.BytesIO()
+        Image.fromarray(arr).save(b, "PNG")
+        return b.getvalue()
+
+    def test_extract_alignment_at_bucket_boundary(self):
+        # 100px tall (bucket 128); extract rows 33..97 -> bucket(65)=96;
+        # 33+96 > 128 would have shifted with dynamic_slice
+        buf = self._gradient_jpgless(100, 100)
+        out = process_operation(
+            "extract", buf,
+            ImageOptions(top=33, left=0, area_width=100, area_height=65, type="png"),
+        )
+        arr = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"))
+        assert arr.shape[:2] == (65, 100)
+        assert arr[0, 0, 0] == 33 and arr[-1, 0, 0] == 97
+
+    def test_watermark_image_position_at_bucket_boundary(self):
+        buf = self._gradient_jpgless(100, 100)
+        wm = np.zeros((65, 65, 4), dtype=np.uint8)
+        wm[..., 0] = 255
+        wm[..., 3] = 255
+        out = process_operation(
+            "watermarkImage", buf,
+            ImageOptions(image="u", top=35, left=35, opacity=1.0, type="png"),
+            watermark_fetcher=lambda u: wm,
+        )
+        arr = np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"))
+        # row 34 untouched, row 35 red; block spans rows/cols 35..99
+        assert arr[34, 40, 0] == 34
+        assert arr[35, 40, 0] == 255
+        assert arr[40, 34, 0] == 40  # left of block: untouched
+        assert arr[99, 99, 0] == 255  # block corner covered
+
+    def test_zoom_negative_factor_rejected(self):
+        buf = self._gradient_jpgless(50, 50)
+        from imaginary_tpu.params import build_params_from_operation
+        from imaginary_tpu.options import PipelineOperation
+        o = build_params_from_operation(PipelineOperation(name="zoom", params={"factor": -2}))
+        with pytest.raises(ImageError):
+            process_operation("zoom", buf, o)
