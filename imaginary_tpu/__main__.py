@@ -1,0 +1,7 @@
+"""`python -m imaginary_tpu` entry point."""
+
+import sys
+
+from imaginary_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,169 @@
+"""CLI and bootstrap (ref: imaginary.go:20-229).
+
+All 35 reference flags are accepted (spelled identically where argparse
+allows), plus TPU-engine flags. Env overrides: PORT, URL_SIGNATURE_KEY, and
+LOG_LEVEL (role of GOLANG_LOG; ref: imaginary.go:231-254).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+from imaginary_tpu.version import Version
+from imaginary_tpu.web.config import (
+    ServerOptions,
+    parse_endpoints,
+    parse_forward_headers,
+    parse_origins,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="imaginary-tpu",
+        description="TPU-native HTTP image processing microservice",
+    )
+    # ref flags (imaginary.go:20-55)
+    p.add_argument("-p", "--port", type=int, default=9000, help="TCP port")
+    p.add_argument("-a", "--addr", default="", help="bind address")
+    p.add_argument("--path-prefix", default="/", help="URL path prefix")
+    p.add_argument("--cors", action="store_true", help="enable CORS")
+    p.add_argument("--gzip", action="store_true", help="deprecated no-op (parity)")
+    p.add_argument("--key", default="", help="API key for authorization")
+    p.add_argument("--mount", default="", help="local directory to serve images from")
+    p.add_argument("--http-cache-ttl", type=int, default=-1, help="cache TTL seconds (0=no-cache)")
+    p.add_argument("--http-read-timeout", type=int, default=60)
+    p.add_argument("--http-write-timeout", type=int, default=60)
+    p.add_argument("--enable-url-source", action="store_true", help="allow GET ?url= fetches")
+    p.add_argument("--enable-placeholder", action="store_true", help="placeholder on errors")
+    p.add_argument("--enable-auth-forwarding", action="store_true")
+    p.add_argument("--enable-url-signature", action="store_true")
+    p.add_argument("--url-signature-key", default="")
+    p.add_argument("--allowed-origins", default="", help="CSV of allowed origin URLs")
+    p.add_argument("--max-allowed-size", type=int, default=0, help="max source bytes")
+    p.add_argument("--max-allowed-resolution", type=float, default=18.0, help="max megapixels")
+    p.add_argument("--certfile", default="")
+    p.add_argument("--keyfile", default="")
+    p.add_argument("--authorization", default="", help="fixed Authorization header for origins")
+    p.add_argument("--forward-headers", default="", help="CSV of headers to forward")
+    p.add_argument("--placeholder", default="", help="placeholder image path")
+    p.add_argument("--placeholder-status", type=int, default=0)
+    p.add_argument("--concurrency", type=int, default=0, help="rate limit (req/sec)")
+    p.add_argument("--burst", type=int, default=100, help="rate limit burst")
+    p.add_argument("--mrelease", type=int, default=30, help="memory release interval seconds")
+    p.add_argument("--cpus", type=int, default=0, help="worker thread cap (0=auto)")
+    p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
+    p.add_argument("--return-size", action="store_true", help="Image-Width/Height headers")
+    p.add_argument("--disable-endpoints", default="", help="CSV of endpoints to disable")
+    p.add_argument("--version", action="store_true")
+    # TPU engine flags (no reference counterpart)
+    p.add_argument("--batch-window-ms", type=float, default=3.0, help="micro-batch window")
+    p.add_argument("--max-batch", type=int, default=8, help="micro-batch size cap")
+    p.add_argument("--use-mesh", action="store_true", help="shard batches over the device mesh")
+    p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
+    p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
+    return p
+
+
+def options_from_args(args) -> ServerOptions:
+    port = args.port
+    if os.environ.get("PORT"):
+        try:
+            port = int(os.environ["PORT"])
+        except ValueError:
+            pass
+    signature_key = args.url_signature_key or os.environ.get("URL_SIGNATURE_KEY", "")
+    log_level = os.environ.get("LOG_LEVEL", args.log_level)
+
+    placeholder_image = b""
+    if args.placeholder:
+        with open(args.placeholder, "rb") as f:
+            placeholder_image = f.read()
+        from imaginary_tpu.imgtype import ImageType, determine_image_type
+
+        if determine_image_type(placeholder_image) is ImageType.UNKNOWN:
+            raise SystemExit("placeholder image is not a valid image")
+
+    if args.enable_url_signature and len(signature_key) < 32:
+        raise SystemExit("URL signature key must be at least 32 characters long")
+    if args.mount and not os.path.isdir(args.mount):
+        raise SystemExit(f"mount directory does not exist: {args.mount}")
+    if args.http_cache_ttl < -1 or args.http_cache_ttl > 31556926:
+        raise SystemExit("The -http-cache-ttl flag only accepts a value from 0 to 31556926")
+
+    return ServerOptions(
+        port=port,
+        address=args.addr,
+        path_prefix=args.path_prefix,
+        cors=args.cors,
+        gzip=args.gzip,
+        api_key=args.key,
+        mount=args.mount,
+        http_cache_ttl=args.http_cache_ttl,
+        http_read_timeout=args.http_read_timeout,
+        http_write_timeout=args.http_write_timeout,
+        enable_url_source=args.enable_url_source,
+        enable_placeholder=args.enable_placeholder,
+        auth_forwarding=args.enable_auth_forwarding,
+        enable_url_signature=args.enable_url_signature,
+        url_signature_key=signature_key,
+        allowed_origins=parse_origins(args.allowed_origins),
+        max_allowed_size=args.max_allowed_size,
+        max_allowed_pixels=args.max_allowed_resolution,
+        cert_file=args.certfile,
+        key_file=args.keyfile,
+        authorization=args.authorization,
+        forward_headers=parse_forward_headers(args.forward_headers),
+        placeholder=args.placeholder,
+        placeholder_image=placeholder_image,
+        placeholder_status=args.placeholder_status,
+        concurrency=args.concurrency,
+        burst=args.burst,
+        log_level=log_level,
+        return_size=args.return_size,
+        cpus=args.cpus,
+        endpoints=parse_endpoints(args.disable_endpoints),
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+        use_mesh=args.use_mesh,
+        n_devices=args.devices or None,
+        prewarm=args.prewarm,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(Version)
+        return 0
+    o = options_from_args(args)
+
+    # Pin the JAX platform when asked (e.g. IMAGINARY_TPU_PLATFORM=cpu for
+    # dev boxes where the TPU plugin force-registers itself at boot).
+    platform = os.environ.get("IMAGINARY_TPU_PLATFORM", "")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    from imaginary_tpu.prewarm import enable_persistent_cache
+
+    enable_persistent_cache()
+    from imaginary_tpu.web.app import serve
+
+    if o.prewarm:
+        from imaginary_tpu.prewarm import prewarm_common_chains
+
+        prewarm_common_chains()
+    try:
+        asyncio.run(serve(o, mrelease=args.mrelease))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Execution engine: micro-batch queue + sharded dispatch + jit cache.
+
+The reference's concurrency model is per-request goroutines ending in a
+blocking libvips call (SURVEY.md section 3.2). Ours inverts it: requests
+park on an asyncio future while a collector groups same-signature work into
+micro-batches that dispatch as ONE sharded device program each — the unit of
+TPU occupancy. See engine/executor.py.
+"""
+
+from imaginary_tpu.engine.executor import Executor, ExecutorConfig, ExecutorStats
+
+__all__ = ["Executor", "ExecutorConfig", "ExecutorStats"]

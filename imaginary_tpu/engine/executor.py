@@ -1,0 +1,182 @@
+"""Micro-batching executor.
+
+Requests (one decoded image + its stage plan) are enqueued from HTTP handler
+threads/tasks; a collector thread groups items that share a chain signature
+(spec sequence + input bucket + channels) and dispatches each group as one
+batched device call — optionally sharded over the mesh's batch axis.
+
+Batch formation policy (SURVEY.md section 7 hard-part #2, latency vs
+throughput): a group dispatches when it reaches `max_batch` items or when its
+oldest item has waited `window_ms`. Under light load the window bounds added
+latency; under heavy load batches fill instantly and the window never
+matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.buckets import bucket_shape
+from imaginary_tpu.ops.plan import ImagePlan
+
+
+@dataclasses.dataclass
+class ExecutorConfig:
+    window_ms: float = 3.0
+    max_batch: int = 8
+    use_mesh: bool = False  # shard micro-batches over the device mesh
+    n_devices: Optional[int] = None  # None = all devices
+    spatial: int = 1  # spatial mesh axis size (sp sharding for huge images)
+
+
+@dataclasses.dataclass
+class ExecutorStats:
+    items: int = 0
+    batches: int = 0
+    max_batch_seen: int = 0
+    queue_depth: int = 0
+    compile_cache_size: int = 0
+
+    def to_dict(self) -> dict:
+        avg = self.items / self.batches if self.batches else 0.0
+        return {
+            "items": self.items,
+            "batches": self.batches,
+            "avg_batch": round(avg, 3),
+            "max_batch": self.max_batch_seen,
+            "queue_depth": self.queue_depth,
+            "compile_cache_size": chain_mod.cache_size(),
+        }
+
+
+class _Item:
+    __slots__ = ("arr", "plan", "future", "key", "t")
+
+    def __init__(self, arr: np.ndarray, plan: ImagePlan):
+        self.arr = arr
+        self.plan = plan
+        self.future: Future = Future()
+        hb, wb = bucket_shape(arr.shape[0], arr.shape[1])
+        self.key = (plan.spec_key(), hb, wb, arr.shape[2])
+        self.t = time.monotonic()
+
+
+class Executor:
+    """Owns the collector thread; submit() is thread-safe."""
+
+    def __init__(self, config: Optional[ExecutorConfig] = None):
+        self.config = config or ExecutorConfig()
+        self.stats = ExecutorStats()
+        self._queue: queue_mod.Queue = queue_mod.Queue()
+        self._sharding = None
+        self._mesh_batch = 1
+        if self.config.use_mesh:
+            from imaginary_tpu.parallel import batch_sharding, get_mesh
+
+            mesh = get_mesh(self.config.n_devices, self.config.spatial)
+            self._sharding = batch_sharding(mesh)
+            self._mesh_batch = mesh.devices.shape[0]
+        self._running = True
+        self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
+        self._thread.start()
+
+    # -- public API ------------------------------------------------------------
+
+    def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
+        """Enqueue one image; resolves to the output HWC uint8 array."""
+        item = _Item(arr, plan)
+        if not plan.stages:  # identity chain: no device work at all
+            item.future.set_result(arr)
+            return item.future
+        self._queue.put(item)
+        return item.future
+
+    def process(self, arr: np.ndarray, plan: ImagePlan, timeout: float = 120.0) -> np.ndarray:
+        """Blocking convenience wrapper."""
+        return self.submit(arr, plan).result(timeout=timeout)
+
+    def shutdown(self):
+        self._running = False
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+
+    # -- collector -------------------------------------------------------------
+
+    def _collector(self):
+        window = self.config.window_ms / 1000.0
+        pending: dict = {}  # key -> list[_Item]
+        while self._running:
+            timeout = None
+            if pending:
+                oldest = min(items[0].t for items in pending.values())
+                timeout = max(0.0, oldest + window - time.monotonic())
+            try:
+                got = self._queue.get(timeout=timeout)
+                if got is None:
+                    break
+                pending.setdefault(got.key, []).append(got)
+            except queue_mod.Empty:
+                pass
+            now = time.monotonic()
+            due = [
+                k for k, items in pending.items()
+                if len(items) >= self.config.max_batch or now - items[0].t >= window
+            ]
+            for k in due:
+                items = pending.pop(k)
+                for start in range(0, len(items), self.config.max_batch):
+                    self._dispatch(items[start : start + self.config.max_batch])
+            self.stats.queue_depth = self._queue.qsize() + sum(len(v) for v in pending.values())
+        # drain on shutdown
+        for items in pending.values():
+            self._dispatch(items)
+
+    def _dispatch(self, items: list):
+        n = len(items)
+        arrs = [it.arr for it in items]
+        plans = [it.plan for it in items]
+        # Pad to a power-of-two batch (and a mesh-axis multiple when
+        # sharded): the jit cache keys on batch shape, so without padding
+        # every distinct size 1..max_batch would pay its own XLA compile.
+        target = 1
+        while target < n:
+            target *= 2
+        if self._sharding is not None:
+            m = self._mesh_batch
+            target = ((target + m - 1) // m) * m
+        if target > n:
+            arrs = arrs + [arrs[-1]] * (target - n)
+            plans = plans + [plans[-1]] * (target - n)
+        try:
+            outs = chain_mod.run_batch(arrs, plans, sharding=self._sharding)
+        except Exception as e:
+            for it in items:
+                it.future.set_exception(e)
+            return
+        self.stats.items += n
+        self.stats.batches += 1
+        self.stats.max_batch_seen = max(self.stats.max_batch_seen, n)
+        for it, out in zip(items, outs):
+            it.future.set_result(out)
+
+
+_DEFAULT: Optional[Executor] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_executor(config: Optional[ExecutorConfig] = None) -> Executor:
+    """Process-wide executor (the HTTP layer's entry point)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Executor(config)
+    return _DEFAULT

@@ -78,25 +78,32 @@ def _stack_dyns(plans: list) -> tuple:
     return tuple(out)
 
 
-def run_batch(arrs: list, plans: list) -> list:
+def run_batch(arrs: list, plans: list, sharding=None) -> list:
     """Execute a batch of same-signature plans in one device call.
 
     arrs: list of HWC uint8 arrays, all with the same bucket shape and C.
     plans: matching ImagePlans with identical spec_key().
+    sharding: optional NamedSharding over the leading batch dim — inputs are
+    placed with it and the jitted program partitions over the mesh.
     Returns the list of HWC uint8 outputs (cropped to each plan's out dims).
     """
     specs = plans[0].spec_key()
     if not specs:
         return [np.asarray(a) for a in arrs]
     batch = np.stack([pad_to_bucket(a) for a in arrs])
-    h = jnp.asarray(np.array([a.shape[0] for a in arrs], dtype=np.int32))
-    w = jnp.asarray(np.array([a.shape[1] for a in arrs], dtype=np.int32))
+    h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
+    w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
     dyns = _stack_dyns(plans)
+    if sharding is not None:
+        batch = jax.device_put(batch, sharding)
+        h = jax.device_put(h, sharding)
+        w = jax.device_put(w, sharding)
+        dyns = tuple({k: jax.device_put(v, sharding) for k, v in d.items()} for d in dyns)
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
     fn = _compiled(specs, batch.shape, dyn_key)
-    y, _, _ = fn(specs, jnp.asarray(batch), h, w, dyns)
+    y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
     y = np.asarray(jax.device_get(y))
     return [y[i, : p.out_h, : p.out_w] for i, p in enumerate(plans)]
 

@@ -1,0 +1,24 @@
+"""Mesh construction and sharding policies (the scale-out layer).
+
+The reference scales by running N identical processes behind a load balancer
+(SURVEY.md section 5.8); the TPU-native equivalent is ONE service spanning a
+device mesh: micro-batches shard over the `batch` axis (ICI data
+parallelism), large images can additionally shard spatially. Multi-host
+extends the same mesh over DCN via jax.distributed.
+"""
+
+from imaginary_tpu.parallel.mesh import (
+    batch_sharding,
+    get_mesh,
+    mesh_devices,
+    pad_batch_for_mesh,
+    replicated_sharding,
+)
+
+__all__ = [
+    "get_mesh",
+    "mesh_devices",
+    "batch_sharding",
+    "replicated_sharding",
+    "pad_batch_for_mesh",
+]

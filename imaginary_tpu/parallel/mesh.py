@@ -1,0 +1,55 @@
+"""Device mesh + sharding helpers.
+
+Axes:
+  batch    data parallelism over micro-batch elements (primary; rides ICI)
+  spatial  optional within-image parallelism for very large images
+           (sampling-matrix einsums shard cleanly on the W axis: each device
+           holds a W-slice of the image; the H-pass matmul is local, the
+           W-pass contracts over the sharded axis and XLA inserts the
+           reduce-scatter/all-gather)
+
+Multi-host: call jax.distributed.initialize() before get_mesh() and the same
+code spans hosts — the mesh is built from jax.devices(), which then includes
+every host's chips (DCN handles cross-host collectives).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@functools.lru_cache(maxsize=None)
+def get_mesh(n_devices: Optional[int] = None, spatial: int = 1) -> Mesh:
+    """Build a (batch, spatial) mesh over the first n_devices devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    spatial = max(1, min(spatial, n))
+    batch = n // spatial
+    grid = np.array(devs[: batch * spatial]).reshape(batch, spatial)
+    return Mesh(grid, ("batch", "spatial"))
+
+
+def mesh_devices(mesh: Mesh) -> int:
+    return int(np.prod(mesh.devices.shape))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim; replicate everything else."""
+    return NamedSharding(mesh, PartitionSpec("batch"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_batch_for_mesh(n: int, mesh: Mesh) -> int:
+    """Round batch size up to a multiple of the batch axis."""
+    b = mesh.devices.shape[0]
+    return ((n + b - 1) // b) * b

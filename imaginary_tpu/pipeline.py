@@ -82,13 +82,16 @@ def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedIma
     return ProcessedImage(body=body, mime=get_image_mime_type(actual))
 
 
-def _run_stages(arr: np.ndarray, plan: ImagePlan) -> np.ndarray:
+def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
     """Device execution with the panic guard (ref: Process recover(),
-    image.go:82-94): backend failures surface as 400s, not 500s."""
+    image.go:82-94): backend failures surface as 400s, not 500s.
+
+    runner: (arr, plan) -> arr; defaults to the direct single-image path,
+    the web layer passes Executor.process for micro-batched dispatch."""
     if not plan.stages:
         return arr
     try:
-        return chain_mod.run_single(arr, plan)
+        return (runner or chain_mod.run_single)(arr, plan)
     except ImageError:
         raise
     except Exception as e:  # XLA/compile/runtime errors
@@ -109,12 +112,13 @@ def process_operation(
     buf: bytes,
     o: ImageOptions,
     watermark_fetcher: Optional[WatermarkFetcher] = None,
+    runner=None,
 ) -> ProcessedImage:
     """Run one named operation end-to-end (decode -> device -> encode)."""
     if name == "info":
         return info(buf, o)
     if name == "pipeline":
-        return process_pipeline(buf, o, watermark_fetcher)
+        return process_pipeline(buf, o, watermark_fetcher, runner=runner)
     if name not in OPERATION_NAMES:
         raise new_error(f"Unsupported operation: {name}", 400)
 
@@ -124,7 +128,7 @@ def process_operation(
         name, o, d.array.shape[0], d.array.shape[1], d.orientation,
         d.array.shape[2], watermark_rgba=wm,
     )
-    arr = _run_stages(d.array, plan)
+    arr = _run_stages(d.array, plan, runner)
     return _encode(arr, o, _encode_type(o, d.type))
 
 
@@ -132,6 +136,7 @@ def process_pipeline(
     buf: bytes,
     o: ImageOptions,
     watermark_fetcher: Optional[WatermarkFetcher] = None,
+    runner=None,
 ) -> ProcessedImage:
     """Fused multi-op pipeline (ref: Pipeline, image.go:379-410).
 
@@ -176,7 +181,7 @@ def process_pipeline(
             target = _encode_type(op_opts, d.type)
 
     combined = ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w)
-    arr = _run_stages(d.array, combined)
+    arr = _run_stages(d.array, combined, runner)
     return _encode(arr, final_o, target)
 
 

@@ -1,0 +1,68 @@
+"""Compile-cache warming + persistence.
+
+The reference is stateless and restart-is-recovery (SURVEY.md section 5.4);
+our only restart cost is XLA compilation. Two mitigations:
+
+  1. a persistent XLA compilation cache on disk (jax's native cache), so a
+     restarted server reuses every executable it ever built;
+  2. optional startup prewarming of the most common (chain, bucket) pairs
+     so the first real request never pays a cold compile (SURVEY.md
+     section 7 hard-part #1).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.plan import plan_operation
+
+
+def enable_persistent_cache(path: str = "") -> str:
+    """Point jax's compilation cache at a durable directory."""
+    import jax
+
+    path = path or os.environ.get(
+        "IMAGINARY_TPU_CACHE", os.path.expanduser("~/.cache/imaginary_tpu/xla")
+    )
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+    return path
+
+
+# (operation, options, source dims) matrix covering the hot routes at the
+# common source sizes; extend as real traffic data accumulates.
+_COMMON = [
+    ("resize", ImageOptions(width=300), (1080, 1920)),
+    ("resize", ImageOptions(width=300, height=200), (1080, 1920)),
+    ("thumbnail", ImageOptions(width=100), (1080, 1920)),
+    ("crop", ImageOptions(width=300, height=260), (1080, 1920)),
+    ("resize", ImageOptions(width=300), (740, 550)),
+    ("fit", ImageOptions(width=300, height=300), (740, 550)),
+]
+
+
+def prewarm_common_chains(batch_sizes=(1,), verbose: bool = True) -> int:
+    """Compile the common chain matrix; returns number of programs built."""
+    built = 0
+    t0 = time.time()
+    for op, opts, (h, w) in _COMMON:
+        for b in batch_sizes:
+            try:
+                plan = plan_operation(op, opts, h, w, 0, 3)
+                arr = np.zeros((h, w, 3), dtype=np.uint8)
+                chain_mod.run_batch([arr] * b, [plan] * b)
+                built += 1
+            except Exception:
+                continue
+    if verbose:
+        print(f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s")
+    return built

@@ -1,0 +1,7 @@
+"""HTTP service shell: server, middleware, controllers, sources.
+
+Preserves the reference's wire contract (routes, params, error JSON,
+signature scheme, placeholder semantics — SURVEY.md sections 1-3) on an
+asyncio (aiohttp) server whose image work dispatches to the micro-batching
+TPU executor.
+"""

@@ -1,0 +1,46 @@
+"""Access log (ref: log.go:12-100).
+
+Apache-combined-ish line per request with latency in seconds (4 decimals),
+level-gated: info logs everything, warning logs status >= 400, error logs
+status >= 500 (ref: log.go:88-99).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from aiohttp import web
+
+_LEVELS = {"debug": 0, "info": 0, "warning": 400, "error": 500}
+
+
+def access_log_middleware(level: str = "info", out=None):
+    threshold = _LEVELS.get(level.lower(), 0)
+    stream = out or sys.stdout
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        start = time.monotonic()
+        status, length = 500, 0  # any non-HTTP exception logs as a 500
+        try:
+            resp = await handler(request)
+            status = resp.status
+            length = resp.content_length or 0
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            if status >= threshold:
+                elapsed = time.monotonic() - start
+                ts = time.strftime("%d/%b/%Y %H:%M:%S", time.localtime())
+                peer = request.remote or "-"
+                line = (
+                    f'{peer} - - [{ts}] "{request.method} {request.path_qs} '
+                    f'HTTP/{request.version.major}.{request.version.minor}" '
+                    f"{status} {length} {elapsed:.4f}\n"
+                )
+                stream.write(line)
+        return resp
+
+    return mw

@@ -1,0 +1,115 @@
+"""Application assembly and server lifecycle (ref: server.go:69-174).
+
+Route table: the 18 image operations + `/`, `/form`, `/health`, all under
+-path-prefix; TLS when cert+key given; graceful shutdown on SIGINT/SIGTERM;
+optional periodic memory release (ref: imaginary.go:339-347).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import ssl
+from functools import partial
+from typing import Optional
+
+from aiohttp import web
+
+from imaginary_tpu.pipeline import ALL_OPERATIONS
+from imaginary_tpu.web.accesslog import access_log_middleware
+from imaginary_tpu.web.config import ServerOptions
+from imaginary_tpu.web.handlers import (
+    ImageService,
+    form_controller,
+    health_controller,
+    index_controller,
+)
+from imaginary_tpu.web.middleware import build_middlewares
+
+
+def create_app(o: ServerOptions, log_stream=None) -> web.Application:
+    app = web.Application(
+        middlewares=[access_log_middleware(o.log_level, log_stream)] + build_middlewares(o),
+        client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
+    )
+    service = ImageService(o)
+    app["service"] = service
+    app["options"] = o
+
+    prefix = o.path_prefix.rstrip("/")
+
+    async def on_cleanup(app):
+        await service.close()
+
+    app.on_cleanup.append(on_cleanup)
+
+    def add(path, handler, methods=("GET", "POST")):
+        for m in methods:
+            app.router.add_route(m, path, handler)
+
+    add(prefix + "/" if prefix else "/", partial(_index, o))
+    add(prefix + "/form", partial(_form, o), methods=("GET",))
+    add(prefix + "/health", partial(_health, service), methods=("GET",))
+
+    for name in ALL_OPERATIONS:
+        route = "/" + (name.lower() if name == "watermarkImage" else name)
+        handler = partial(_image, service, name)
+        app.router.add_route("GET", prefix + route, handler)
+        app.router.add_route("POST", prefix + route, handler)
+    return app
+
+
+async def _index(o, request):
+    return await index_controller(request, o)
+
+
+async def _form(o, request):
+    return await form_controller(request, o)
+
+
+async def _health(service, request):
+    return await health_controller(request, service)
+
+
+async def _image(service, name, request):
+    return await service.handle(request, name)
+
+
+def make_ssl_context(o: ServerOptions) -> Optional[ssl.SSLContext]:
+    if not (o.cert_file and o.key_file):
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_2  # ref: server.go:115
+    ctx.load_cert_chain(o.cert_file, o.key_file)
+    return ctx
+
+
+async def serve(o: ServerOptions, mrelease: int = 30) -> None:
+    """Run until SIGINT/SIGTERM; graceful 5s drain (ref: server.go:144-165)."""
+    import signal
+
+    app = create_app(o)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, o.address or None, o.port, ssl_context=make_ssl_context(o))
+    await site.start()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    async def memory_release():
+        # role of the reference's FreeOSMemory ticker (imaginary.go:339-347)
+        while not stop.is_set():
+            await asyncio.sleep(max(mrelease, 1))
+            gc.collect()
+
+    ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
+    scheme = "https" if o.cert_file and o.key_file else "http"
+    print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}")
+    await stop.wait()
+    print("shutting down server")
+    if ticker:
+        ticker.cancel()
+    await asyncio.wait_for(runner.cleanup(), timeout=5)

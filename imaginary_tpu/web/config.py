@@ -1,0 +1,92 @@
+"""Server configuration (ref: ServerOptions, server.go:20-51).
+
+Immutable after startup, threaded through every constructor — no globals
+(matching the reference's config discipline, SURVEY.md section 5.6) — plus
+the TPU-engine knobs that have no reference counterpart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+from urllib.parse import urlparse
+
+
+@dataclasses.dataclass
+class ServerOptions:
+    port: int = 9000
+    address: str = ""
+    path_prefix: str = "/"
+    burst: int = 100
+    concurrency: int = 0
+    http_cache_ttl: int = -1
+    http_read_timeout: int = 60
+    http_write_timeout: int = 60
+    max_allowed_size: int = 0
+    max_allowed_pixels: float = 18.0  # megapixels (ref: imaginary.go:36)
+    cors: bool = False
+    gzip: bool = False  # accepted for CLI parity; deprecated upstream
+    auth_forwarding: bool = False
+    enable_url_source: bool = False
+    enable_placeholder: bool = False
+    enable_url_signature: bool = False
+    url_signature_key: str = ""
+    api_key: str = ""
+    mount: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    authorization: str = ""
+    placeholder: str = ""
+    placeholder_status: int = 0
+    forward_headers: tuple = ()
+    placeholder_image: bytes = b""
+    endpoints: tuple = ()  # disabled endpoint names (ref: Endpoints)
+    allowed_origins: tuple = ()  # parsed urlparse results
+    log_level: str = "info"
+    return_size: bool = False
+    cpus: int = 0  # host worker-thread cap, 0 = auto (role of -cpus/GOMAXPROCS)
+    # --- TPU engine knobs (no reference counterpart) -------------------------
+    batch_window_ms: float = 3.0
+    max_batch: int = 8
+    use_mesh: bool = False
+    n_devices: Optional[int] = None
+    prewarm: bool = False
+
+    def is_endpoint_enabled(self, path: str) -> bool:
+        """Endpoint disabling by last path segment (ref: server.go:57-66)."""
+        segment = path.rstrip("/").split("/")[-1]
+        return segment not in self.endpoints
+
+
+def parse_origins(value: str) -> tuple:
+    """CSV of allowed origin URLs (ref: imaginary.go:303-326).
+
+    The reference moves a wildcard prefix from the path into the host when
+    the URL parser left `*.example.com` in the path portion (origins given
+    without a scheme); accepting both spellings matters for parity with its
+    documented examples.
+    """
+    origins = []
+    for raw in value.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        u = urlparse(raw if "//" in raw else "//" + raw)
+        host, path = u.netloc, u.path or ""
+        if host == "" and path.startswith("*."):
+            # "*.example.com/foo" parses host-less; recover host from path
+            parts = path.split("/", 1)
+            host = parts[0]
+            path = "/" + parts[1] if len(parts) > 1 else ""
+        origins.append((host, path))
+    return tuple(origins)
+
+
+def parse_endpoints(value: str) -> tuple:
+    """CSV of endpoint names to disable (ref: imaginary.go:328-337)."""
+    return tuple(e.strip().lower() for e in value.split(",") if e.strip())
+
+
+def parse_forward_headers(value: str) -> tuple:
+    """CSV of header names to forward to origins (ref: imaginary.go:289-301)."""
+    return tuple(h.strip() for h in value.split(",") if h.strip())

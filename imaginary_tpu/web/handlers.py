@@ -1,0 +1,254 @@
+"""Controllers and the live image handler.
+
+The reference's LIVE path skips several documented behaviors that only exist
+on its dead controller path (SURVEY.md section 2.13.1); per the survey's
+build decision this handler implements the FULL imageHandler semantics
+(controllers.go:79-156) live: media-type sniffing, `type=auto` Accept
+negotiation with `Vary: Accept`, output-format validation, the
+max-allowed-resolution guard, and `-return-size` headers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+from aiohttp import web
+
+from imaginary_tpu import codecs
+from imaginary_tpu.engine import Executor, ExecutorConfig
+from imaginary_tpu.errors import (
+    ErrEmptyBody,
+    ErrNotFound,
+    ErrOutputFormat,
+    ErrResolutionTooBig,
+    ErrUnsupportedMedia,
+    ImageError,
+    new_error,
+)
+from imaginary_tpu.imgtype import (
+    determine_image_type,
+    get_image_mime_type,
+    image_type,
+    ImageType,
+    is_image_mime_type_supported,
+)
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.params import ParamError, build_params_from_query
+from imaginary_tpu.pipeline import ALL_OPERATIONS, process_operation
+from imaginary_tpu.version import current_versions
+from imaginary_tpu.web.config import ServerOptions
+from imaginary_tpu.web.health import get_health_stats
+from imaginary_tpu.web.middleware import (
+    check_url_signature,
+    error_response,
+    validate_image_request,
+)
+from imaginary_tpu.web.sources import SourceRegistry
+
+_ACCEPT_TO_TYPE = {"image/webp": "webp", "image/png": "png", "image/jpeg": "jpeg"}
+
+
+def determine_accept_mime_type(accept: str) -> str:
+    """Preferred output format from the Accept header
+    (ref: controllers.go:63-76)."""
+    for part in accept.split(","):
+        media = part.split(";", 1)[0].strip().lower()
+        if media in _ACCEPT_TO_TYPE:
+            return _ACCEPT_TO_TYPE[media]
+    return ""
+
+
+class ImageService:
+    """Owns the micro-batch executor, the host thread pool (decode/encode
+    parallelism), and the source registry."""
+
+    def __init__(self, o: ServerOptions):
+        self.options = o
+        self.registry = SourceRegistry(o)
+        self.executor = Executor(
+            ExecutorConfig(
+                window_ms=o.batch_window_ms,
+                max_batch=o.max_batch,
+                use_mesh=o.use_mesh,
+                n_devices=o.n_devices,
+            )
+        )
+        import os as _os
+
+        workers = o.cpus if o.cpus > 0 else max(4, int(_os.cpu_count() or 4))
+        self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="itpu-host")
+
+    async def close(self):
+        await self.registry.close()
+        self.executor.shutdown()
+        self.pool.shutdown(wait=False)
+
+    # -- the image route handler ----------------------------------------------
+
+    async def handle(self, request: web.Request, op_name: str) -> web.StreamResponse:
+        o = self.options
+        try:
+            if o.enable_url_signature:
+                check_url_signature(request, o)
+            validate_image_request(request, o)
+            buf = await self._get_source_image(request)
+            if not buf:
+                raise ErrEmptyBody
+            return await self._process_and_respond(request, op_name, buf)
+        except ImageError as e:
+            return error_response(request, e, o)
+        except ParamError as e:
+            return error_response(request, new_error(str(e), 400), o)
+
+    async def _get_source_image(self, request: web.Request) -> bytes:
+        try:
+            return await self.registry.get_image(request)
+        except ImageError:
+            raise
+        except Exception as e:
+            raise new_error("Error getting image: " + str(e), 400) from None
+
+    async def _process_and_respond(self, request, op_name, buf) -> web.Response:
+        o = self.options
+
+        # media-type sniff (ref: imageHandler controllers.go:80-84)
+        sniffed = determine_image_type(buf)
+        if sniffed is ImageType.UNKNOWN or not is_image_mime_type_supported(
+            get_image_mime_type(sniffed)
+        ):
+            raise ErrUnsupportedMedia
+
+        try:
+            opts = build_params_from_query(dict(request.query))
+        except ParamError as e:
+            raise new_error("Error while processing parameters: " + str(e), 400) from None
+
+        # type=auto Accept negotiation (ref: controllers.go:89-99)
+        vary = ""
+        if opts.type == "auto":
+            opts.type = determine_accept_mime_type(request.headers.get("Accept", ""))
+            vary = "Accept"
+        elif opts.type and image_type(opts.type) is ImageType.UNKNOWN:
+            raise ErrOutputFormat
+
+        # resolution guard (ref: controllers.go:101-110)
+        if o.max_allowed_pixels > 0:
+            try:
+                meta = codecs.probe(buf)
+                if (meta.width * meta.height / 1_000_000.0) > o.max_allowed_pixels:
+                    raise ErrResolutionTooBig
+            except ImageError as e:
+                if e is ErrResolutionTooBig:
+                    raise
+                # probe failure falls through; decode will produce the error
+
+        loop = asyncio.get_running_loop()
+        wm_rgba = await self._prefetch_watermark(request, op_name, opts)
+        try:
+            out = await loop.run_in_executor(
+                self.pool, self._process_sync, op_name, buf, opts, wm_rgba
+            )
+        except ImageError:
+            raise
+        except Exception as e:
+            raise new_error("Error processing image: " + str(e), 400) from None
+
+        headers = {}
+        if vary:
+            headers["Vary"] = vary
+        if o.return_size and out.mime != "application/json":
+            try:
+                m = codecs.probe(out.body)
+                headers["Image-Width"] = str(m.width)
+                headers["Image-Height"] = str(m.height)
+            except ImageError:
+                pass
+        return web.Response(body=out.body, content_type=out.mime, headers=headers)
+
+    async def _prefetch_watermark(self, request, op_name, opts) -> Optional[np.ndarray]:
+        """watermarkImage URL fetch happens async, before thread dispatch
+        (ref: image.go:343-357; origin-checked unlike the reference)."""
+        url = ""
+        if op_name == "watermarkImage":
+            url = opts.image
+        elif op_name == "pipeline":
+            for op in opts.operations:
+                if op.name == "watermarkImage":
+                    url = str(op.params.get("image", ""))
+                    break
+        if not url:
+            return None
+        raw = await self.registry.fetch_watermark(url)
+        if not raw:
+            raise new_error("Unable to read watermark image", 400)
+        d = codecs.decode(raw)
+        arr = d.array
+        if arr.shape[2] == 3:
+            alpha = np.full(arr.shape[:2] + (1,), 255, dtype=np.uint8)
+            arr = np.concatenate([arr, alpha], axis=2)
+        return arr
+
+    def _process_sync(self, op_name, buf, opts, wm_rgba):
+        fetcher = (lambda url: wm_rgba) if wm_rgba is not None else None
+        return process_operation(
+            op_name, buf, opts, watermark_fetcher=fetcher, runner=self.executor.process
+        )
+
+
+# --- simple controllers -------------------------------------------------------
+
+async def index_controller(request: web.Request, o: ServerOptions) -> web.Response:
+    """Version JSON (ref: controllers.go:17-26)."""
+    prefix = o.path_prefix.rstrip("/") or ""
+    if request.path not in (prefix + "/", prefix or "/"):
+        return error_response(request, ErrNotFound, o)
+    return web.json_response(current_versions().to_dict())
+
+
+async def health_controller(request: web.Request, service: Optional[ImageService]) -> web.Response:
+    return web.json_response(
+        get_health_stats(service.executor if service else None)
+    )
+
+
+async def form_controller(request: web.Request, o: ServerOptions) -> web.Response:
+    """HTML playground (ref: controllers.go:159-194)."""
+    prefix = o.path_prefix.rstrip("/")
+    demos = [
+        ("Resize", "resize", "width=300&height=200&type=jpeg"),
+        ("Force resize", "resize", "width=300&height=200&force=true"),
+        ("Crop", "crop", "width=300&quality=95"),
+        ("SmartCrop", "crop", "width=300&height=260&quality=95&gravity=smart"),
+        ("Extract", "extract", "top=100&left=100&areawidth=300&areaheight=150"),
+        ("Enlarge", "enlarge", "width=1440&height=900&quality=95"),
+        ("Rotate", "rotate", "rotate=180"),
+        ("AutoRotate", "autorotate", "quality=90"),
+        ("Flip", "flip", ""),
+        ("Flop", "flop", ""),
+        ("Thumbnail", "thumbnail", "width=100"),
+        ("Zoom", "zoom", "factor=2&areawidth=300&top=80&left=80"),
+        ("Color space (black&white)", "resize", "width=400&height=300&colorspace=bw"),
+        ("Add watermark", "watermark", "textwidth=100&text=Hello&font=sans%2012&opacity=0.5&color=255,200,50"),
+        ("Convert format", "convert", "type=png"),
+        ("Image metadata", "info", ""),
+        ("Gaussian blur", "blur", "sigma=15.0&minampl=0.2"),
+        ("Pipeline", "pipeline",
+         "operations=%5B%7B%22operation%22:%20%22crop%22,%20%22params%22:%20%7B%22width%22:%20300,"
+         "%20%22height%22:%20260%7D%7D,%20%7B%22operation%22:%20%22convert%22,%20%22params%22:"
+         "%20%7B%22type%22:%20%22webp%22%7D%7D%5D"),
+    ]
+    parts = ["<html><body>"]
+    for title, op, args in demos:
+        action = f"{prefix}/{op}" + (f"?{args}" if args else "")
+        parts.append(
+            f'<h1>{title}</h1>'
+            f'<form method="POST" action="{action}" enctype="multipart/form-data">'
+            f'<input type="file" name="file" /><input type="submit" value="Upload" />'
+            f"</form>"
+        )
+    parts.append("</body></html>")
+    return web.Response(text="".join(parts), content_type="text/html")

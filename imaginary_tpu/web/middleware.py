@@ -1,0 +1,228 @@
+"""Middleware chain (ref: middleware.go:21-245).
+
+aiohttp middlewares compose in the same effective order as the reference's
+handler wrappers: request validation -> default headers -> cache headers ->
+API key -> CORS -> throttle -> endpoint disabling, with the HMAC URL
+signature check and image-request validation applied to image routes.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import time
+from email.utils import formatdate
+from urllib.parse import urlencode
+
+from aiohttp import web
+
+from imaginary_tpu.errors import (
+    ErrGetMethodNotAllowed,
+    ErrInvalidAPIKey,
+    ErrInvalidURLSignature,
+    ErrMethodNotAllowed,
+    ErrNotImplemented,
+    ErrURLSignatureMismatch,
+    ImageError,
+)
+from imaginary_tpu.version import Version
+from imaginary_tpu.web.config import ServerOptions
+
+PUBLIC_PATHS = ("/", "/health", "/form")  # ref: middleware.go:231-238
+
+
+def is_public_path(o: ServerOptions, path: str) -> bool:
+    prefix = o.path_prefix.rstrip("/")
+    if prefix and path.startswith(prefix):
+        path = path[len(prefix):] or "/"
+    return path in PUBLIC_PATHS
+
+
+class GCRARateLimiter:
+    """Generic cell rate algorithm, keyed by request method (the reference
+    uses throttled/v2 with VaryBy{Method}; middleware.go:125-145)."""
+
+    def __init__(self, per_sec: int, burst: int):
+        self.emission = 1.0 / max(per_sec, 1)
+        self.tau = self.emission * max(burst, 0)
+        self._tat: dict = {}
+        self._lock = threading.Lock()
+
+    def allow(self, key: str):
+        """Returns (allowed, retry_after_seconds)."""
+        now = time.monotonic()
+        with self._lock:
+            tat = max(self._tat.get(key, now), now)
+            if tat - now > self.tau:
+                return False, tat - self.tau - now
+            self._tat[key] = tat + self.emission
+            return True, 0.0
+
+
+def error_response(request: web.Request, err: ImageError, o: ServerOptions) -> web.StreamResponse:
+    """ErrorReply equivalent (error.go:58-67): JSON error, or placeholder
+    image when enabled."""
+    if o.enable_placeholder or o.placeholder:
+        from imaginary_tpu.web.placeholder import placeholder_response
+
+        resp = placeholder_response(request, err, o)
+        if resp is not None:
+            return resp
+    return web.Response(
+        body=err.json_bytes(),
+        status=err.http_code(),
+        content_type="application/json",
+    )
+
+
+def build_middlewares(o: ServerOptions) -> list:
+    """The chain, outermost first."""
+    mws = [_validate_request(o), _default_headers(o)]
+    if o.http_cache_ttl >= 0:
+        mws.append(_cache_headers(o))
+    if o.api_key:
+        mws.append(_authorize(o))
+    if o.cors:
+        mws.append(_cors(o))
+    if o.concurrency > 0:
+        mws.append(_throttle(o))
+    if o.endpoints:
+        mws.append(_endpoints_guard(o))
+    return mws
+
+
+def _validate_request(o: ServerOptions):
+    @web.middleware
+    async def mw(request, handler):
+        # GET/POST only (ref: middleware.go:179-187); OPTIONS passes only
+        # for CORS preflight
+        if request.method not in ("GET", "POST") and not (request.method == "OPTIONS" and o.cors):
+            return error_response(request, ErrMethodNotAllowed, o)
+        return await handler(request)
+
+    return mw
+
+
+def _default_headers(o: ServerOptions):
+    @web.middleware
+    async def mw(request, handler):
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            e.headers["Server"] = f"imaginary-tpu {Version}"
+            raise
+        resp.headers["Server"] = f"imaginary-tpu {Version}"
+        return resp
+
+    return mw
+
+
+def _cache_headers(o: ServerOptions):
+    ttl = o.http_cache_ttl
+
+    @web.middleware
+    async def mw(request, handler):
+        resp = await handler(request)
+        if request.method == "GET" and not is_public_path(o, request.path):
+            if ttl == 0:
+                control = "private, no-cache, no-store, must-revalidate"
+            else:
+                control = f"public, s-maxage={ttl}, max-age={ttl}, no-transform"
+            resp.headers["Cache-Control"] = control
+            resp.headers["Expires"] = formatdate(time.time() + ttl, usegmt=True)
+        return resp
+
+    return mw
+
+
+def _authorize(o: ServerOptions):
+    @web.middleware
+    async def mw(request, handler):
+        key = request.headers.get("API-Key") or request.query.get("key", "")
+        if key != o.api_key:
+            return error_response(request, ErrInvalidAPIKey, o)
+        return await handler(request)
+
+    return mw
+
+
+def _cors(o: ServerOptions):
+    @web.middleware
+    async def mw(request, handler):
+        if request.method == "OPTIONS":
+            resp = web.Response(status=204)
+        else:
+            resp = await handler(request)
+        resp.headers["Access-Control-Allow-Origin"] = "*"
+        resp.headers["Access-Control-Allow-Methods"] = "GET, POST"
+        resp.headers["Access-Control-Allow-Headers"] = "Origin, Accept, Content-Type, API-Key"
+        return resp
+
+    return mw
+
+
+def _throttle(o: ServerOptions):
+    limiter = GCRARateLimiter(o.concurrency, o.burst)
+
+    @web.middleware
+    async def mw(request, handler):
+        allowed, retry = limiter.allow(request.method)
+        if not allowed:
+            return web.Response(
+                status=429,
+                text="Too Many Requests",
+                headers={"Retry-After": str(max(1, int(retry + 0.5)))},
+            )
+        return await handler(request)
+
+    return mw
+
+
+def _endpoints_guard(o: ServerOptions):
+    @web.middleware
+    async def mw(request, handler):
+        if not o.is_endpoint_enabled(request.path):
+            return error_response(request, ErrNotImplemented, o)
+        return await handler(request)
+
+    return mw
+
+
+# --- image-route-only guards (ref: ImageMiddleware, middleware.go:43-54) ------
+
+def check_url_signature(request: web.Request, o: ServerOptions):
+    """HMAC-SHA256 over path + sorted query minus `sign`, base64url-raw
+    (ref: middleware.go:205-229). Raises on failure."""
+    query = [(k, v) for k, v in request.query.items() if k != "sign"]
+    sign = request.query.get("sign", "")
+    mac = hmac.new(o.url_signature_key.encode(), digestmod=hashlib.sha256)
+    mac.update(request.path.encode())
+    mac.update(urlencode(sorted(query)).encode())
+    try:
+        # raw (unpadded) URL-safe base64, strict alphabet (Go's
+        # base64.RawURLEncoding errors on invalid chars; Python's default
+        # silently drops them)
+        given = base64.b64decode(sign + "=" * (-len(sign) % 4), altchars=b"-_", validate=True)
+    except Exception:
+        raise ErrInvalidURLSignature from None
+    if not hmac.compare_digest(given, mac.digest()):
+        raise ErrURLSignatureMismatch
+
+
+def validate_image_request(request: web.Request, o: ServerOptions):
+    """GET image requests need -mount or -enable-url-source
+    (ref: middleware.go:189-203)."""
+    if request.method == "GET" and not is_public_path(o, request.path):
+        if not o.mount and not o.enable_url_source:
+            raise ErrGetMethodNotAllowed
+
+
+def sign_url(key: str, path: str, query_pairs: list) -> str:
+    """Client-side signing helper (inverse of check_url_signature); exposed
+    for tests and documentation parity with the reference README."""
+    mac = hmac.new(key.encode(), digestmod=hashlib.sha256)
+    mac.update(path.encode())
+    mac.update(urlencode(sorted(query_pairs)).encode())
+    return base64.urlsafe_b64encode(mac.digest()).decode().rstrip("=")

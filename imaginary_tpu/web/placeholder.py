@@ -1,0 +1,71 @@
+"""Placeholder-image degradation (ref: error.go:69-107, placeholder.go).
+
+When enabled, errors return a placeholder image resized to the requested
+dimensions, with the real error JSON in the `Error` response header and the
+status from -placeholder-status (or the original error). The default
+placeholder is generated procedurally (a neutral gray 1200x1200 JPEG) rather
+than shipping an embedded base64 blob like the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+from aiohttp import web
+
+from imaginary_tpu import codecs
+from imaginary_tpu.codecs import EncodeOptions
+from imaginary_tpu.errors import ImageError
+from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.params import parse_int
+from imaginary_tpu.web.config import ServerOptions
+
+
+@functools.lru_cache(maxsize=1)
+def default_placeholder() -> bytes:
+    """1200x1200 neutral placeholder (role of placeholder.go:10-13)."""
+    side = 1200
+    yy, xx = np.mgrid[0:side, 0:side]
+    base = (208 + 16 * np.cos(xx / 97.0) * np.cos(yy / 97.0)).astype(np.uint8)
+    arr = np.stack([base, base, base], axis=-1)
+    return codecs.encode(arr, EncodeOptions(type=ImageType.JPEG, quality=85))
+
+
+def placeholder_response(request: web.Request, err: ImageError,
+                         o: ServerOptions) -> Optional[web.Response]:
+    """Build the placeholder reply; None falls back to the JSON error
+    (mirrors replyWithPlaceholder's own error path, error.go:90-93)."""
+    from imaginary_tpu.pipeline import process_operation
+
+    buf = o.placeholder_image or default_placeholder()
+    try:
+        width = parse_int(request.query.get("width", ""))
+        height = parse_int(request.query.get("height", ""))
+    except Exception:
+        return None
+    opts = ImageOptions(
+        width=width or 0,
+        height=height or 0,
+        force=True,
+        type=request.query.get("type", ""),
+    )
+    if opts.type and image_type(opts.type) is ImageType.UNKNOWN:
+        opts.type = ""
+    try:
+        if opts.width or opts.height:
+            out = process_operation("resize", buf, opts)
+            body, mime = out.body, out.mime
+        else:
+            body, mime = buf, get_image_mime_type(ImageType.JPEG)
+    except Exception:
+        return None
+    status = o.placeholder_status if o.placeholder_status else err.http_code()
+    return web.Response(
+        body=body,
+        status=status,
+        content_type=mime,
+        headers={"Error": err.json_bytes().decode()},
+    )

@@ -1,0 +1,267 @@
+"""Image sources: where request pixels come from.
+
+Reimplements the reference's source registry + three sources (source.go,
+source_http.go, source_fs.go, source_body.go): a request is matched against
+registered sources and the first match fetches the bytes. Async throughout
+(aiohttp client for remote fetches), unlike the reference's blocking
+net/http.
+
+Deliberate fixes over the fork (SURVEY.md section 2.13): deterministic
+match order (body > fs > http instead of Go map iteration), full reads on
+file sources (no short-read risk), and the watermark-image fetch honors the
+origin allow-list instead of fetching any URL.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from imaginary_tpu.errors import (
+    ErrEntityTooLarge,
+    ErrInvalidFilePath,
+    ErrInvalidImageURL,
+    ErrMissingParamFile,
+    ImageError,
+    new_error,
+)
+from imaginary_tpu.version import Version
+from imaginary_tpu.web.config import ServerOptions
+
+MAX_BODY_SIZE = 1 << 26  # 64 MB (ref: source_body.go:13)
+FORM_FIELD = "file"  # hard-coded upstream too (source_body.go:12)
+HTTP_TIMEOUT = 60  # seconds (ref: source_http.go:16)
+WATERMARK_MAX_BYTES = 1_000_000  # ref: image.go:352
+
+
+class BodyImageSource:
+    """POST/PUT payloads: multipart `file` field or raw body
+    (ref: source_body.go:30-100)."""
+
+    name = "payload"
+
+    def matches(self, request: web.Request) -> bool:
+        return request.method in ("POST", "PUT")
+
+    async def get_image(self, request: web.Request) -> bytes:
+        ctype = request.headers.get("Content-Type", "")
+        if ctype.startswith("multipart/"):
+            return await self._read_form(request)
+        return await self._read_raw(request)
+
+    async def _read_form(self, request: web.Request) -> bytes:
+        reader = await request.multipart()
+        async for part in reader:
+            if part.name == FORM_FIELD:
+                data = bytearray()
+                while True:
+                    chunk = await part.read_chunk(1 << 16)
+                    if not chunk:
+                        break
+                    data.extend(chunk)
+                    if len(data) > MAX_BODY_SIZE:
+                        raise ErrEntityTooLarge
+                return bytes(data)
+        raise ErrMissingParamFile
+
+    async def _read_raw(self, request: web.Request) -> bytes:
+        data = bytearray()
+        async for chunk in request.content.iter_chunked(1 << 16):
+            data.extend(chunk)
+            if len(data) > MAX_BODY_SIZE:
+                raise ErrEntityTooLarge
+        return bytes(data)
+
+
+class FileSystemImageSource:
+    """GET ?file= under the -mount directory with traversal protection
+    (ref: source_fs.go:28-91)."""
+
+    name = "fs"
+
+    def __init__(self, mount: str):
+        self.mount = os.path.abspath(mount)
+
+    def matches(self, request: web.Request) -> bool:
+        return request.method == "GET" and bool(request.query.get("file"))
+
+    async def get_image(self, request: web.Request) -> bytes:
+        raw = request.query.get("file", "")
+        name = urllib.parse.unquote(raw)
+        path = os.path.normpath(os.path.join(self.mount, name.lstrip("/")))
+        if not (path == self.mount or path.startswith(self.mount + os.sep)):
+            raise ErrInvalidFilePath
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise ErrInvalidFilePath from None
+        except IsADirectoryError:
+            raise ErrInvalidFilePath from None
+
+
+class HTTPImageSource:
+    """GET ?url= remote fetch with origin allow-list, HEAD size pre-check,
+    and auth/header forwarding (ref: source_http.go:24-160)."""
+
+    name = "http"
+
+    def __init__(self, o: ServerOptions):
+        self.options = o
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def matches(self, request: web.Request) -> bool:
+        return request.method == "GET" and bool(request.query.get("url"))
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=HTTP_TIMEOUT),
+                auto_decompress=False,
+                connector=aiohttp.TCPConnector(limit=100, limit_per_host=10),
+            )
+        return self._session
+
+    async def close(self):
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def get_image(self, request: web.Request) -> bytes:
+        raw = request.query.get("url", "")
+        u = urllib.parse.urlparse(raw)
+        if not u.scheme or not u.netloc:
+            raise ErrInvalidImageURL
+        if should_restrict_origin(u, self.options.allowed_origins):
+            raise new_error(f"not allowed remote URL origin: {u.netloc}{u.path}", 400)
+        return await self.fetch(raw, request)
+
+    async def fetch(self, url: str, request: Optional[web.Request],
+                    limit: Optional[int] = None) -> bytes:
+        sess = await self.session()
+        headers = self._build_headers(request)
+        max_size = limit or self.options.max_allowed_size
+        if self.options.max_allowed_size > 0 and limit is None:
+            await self._check_size(sess, url, headers)
+        try:
+            async with sess.get(url, headers=headers) as res:
+                if res.status != 200:
+                    raise new_error(
+                        f"error fetching remote http image: (status={res.status}) (url={url})",
+                        res.status,
+                    )
+                data = bytearray()
+                async for chunk in res.content.iter_chunked(1 << 16):
+                    data.extend(chunk)
+                    if max_size and len(data) > max_size:
+                        data = data[:max_size]  # LimitReader semantics
+                        break
+                return bytes(data)
+        except ImageError:
+            raise
+        except Exception as e:
+            raise new_error(f"error fetching remote http image: {e}", 400) from None
+
+    async def _check_size(self, sess, url: str, headers: dict):
+        """HEAD pre-check (ref: source_http.go:105-124, accepts 200-206)."""
+        try:
+            async with sess.head(url, headers=headers) as res:
+                if res.status < 200 or res.status > 206:
+                    raise new_error(
+                        f"invalid status checking image size: (status={res.status}) (url={url})",
+                        res.status,
+                    )
+                length = res.headers.get("Content-Length")
+                if length and int(length) > self.options.max_allowed_size:
+                    raise new_error(
+                        f"content length {length} exceeds maximum allowed "
+                        f"{self.options.max_allowed_size} bytes", 400,
+                    )
+        except ImageError:
+            raise
+        except Exception as e:
+            raise new_error(f"error checking image size: {e}", 400) from None
+
+    def _build_headers(self, request: Optional[web.Request]) -> dict:
+        headers = {"User-Agent": f"imaginary-tpu/{Version}"}
+        o = self.options
+        if request is not None:
+            # priority: fixed -authorization > X-Forward-Authorization >
+            # Authorization (ref: source_http.go:142-151)
+            if o.authorization:
+                headers["Authorization"] = o.authorization
+            elif o.auth_forwarding:
+                fwd = request.headers.get("X-Forward-Authorization") or request.headers.get("Authorization")
+                if fwd:
+                    headers["Authorization"] = fwd
+            for h in o.forward_headers:
+                v = request.headers.get(h)
+                if v:
+                    headers[h] = v
+        elif o.authorization:
+            headers["Authorization"] = o.authorization
+        return headers
+
+
+def should_restrict_origin(u, origins: tuple) -> bool:
+    """Origin allow-list with `*.host` wildcards and path prefixes
+    (ref: source_http.go:57-78)."""
+    if not origins:
+        return False
+    host, path = u.netloc, u.path or ""
+    for origin_host, origin_path in origins:
+        if origin_host == host and path.startswith(origin_path):
+            return False
+        if origin_host.startswith("*."):
+            suffix = origin_host[1:]  # ".example.com"
+            if (host == origin_host[2:] or host.endswith(suffix)) and path.startswith(origin_path):
+                return False
+    return True
+
+
+class SourceRegistry:
+    """Deterministic-order source matching (ref: source.go:33-99, minus the
+    map-iteration nondeterminism flagged in SURVEY.md section 2.13)."""
+
+    def __init__(self, o: ServerOptions):
+        self.options = o
+        self.sources: list = [BodyImageSource()]
+        if o.mount:
+            self.sources.append(FileSystemImageSource(o.mount))
+        if o.enable_url_source:
+            self.sources.append(HTTPImageSource(o))
+
+    def match(self, request: web.Request):
+        for s in self.sources:
+            if s.matches(request):
+                return s
+        return None
+
+    async def get_image(self, request: web.Request) -> bytes:
+        source = self.match(request)
+        if source is None:
+            raise new_error("missing image source", 400)
+        return await source.get_image(request)
+
+    async def fetch_watermark(self, url: str) -> bytes:
+        """Watermark-image fetch (ref: image.go:343-357) — 1 MB cap, and
+        unlike the reference's bare http.Get it honors the origin
+        allow-list (closes the SSRF surface noted in SURVEY.md 2.13.6)."""
+        u = urllib.parse.urlparse(url)
+        if not u.scheme or not u.netloc:
+            raise new_error(f"Unable to retrieve watermark image: {url}", 400)
+        if should_restrict_origin(u, self.options.allowed_origins):
+            raise new_error(f"Unable to retrieve watermark image: {url}", 400)
+        http_source = next((s for s in self.sources if isinstance(s, HTTPImageSource)), None)
+        if http_source is None:
+            http_source = HTTPImageSource(self.options)
+            self.sources.append(http_source)
+        return await http_source.fetch(url, None, limit=WATERMARK_MAX_BYTES)
+
+    async def close(self):
+        for s in self.sources:
+            if isinstance(s, HTTPImageSource):
+                await s.close()

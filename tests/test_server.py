@@ -1,0 +1,431 @@
+"""HTTP integration tests (modeled on server_test.go).
+
+Each test spins an in-process aiohttp app (and, where needed, a fake origin
+server — the reference's httptest.NewServer pattern, server_test.go:282-285)
+and asserts on the wire: status, headers, and decoded output dimensions via
+PIL.
+"""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+import pytest
+from aiohttp import FormData
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+from imaginary_tpu.web.app import create_app
+from imaginary_tpu.web.config import ServerOptions, parse_origins
+from imaginary_tpu.web.middleware import sign_url
+from tests.conftest import FIXTURES, fixture_bytes
+
+
+def run(options, fn, origin_handler=None):
+    """Run `fn(client, origin_url)` against a fresh app instance."""
+
+    async def runner():
+        from aiohttp import web
+
+        origin_url = None
+        origin = None
+        if origin_handler is not None:
+            oapp = web.Application()
+            oapp.router.add_route("*", "/{tail:.*}", origin_handler)
+            origin = TestServer(oapp)
+            await origin.start_server()
+            origin_url = f"http://127.0.0.1:{origin.port}"
+
+        app = create_app(options, log_stream=io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, origin_url)
+        finally:
+            await client.close()
+            if origin is not None:
+                await origin.close()
+
+    asyncio.run(runner())
+
+
+def oracle_size(body: bytes):
+    im = Image.open(io.BytesIO(body))
+    return im.width, im.height
+
+
+def multipart_jpg():
+    form = FormData()
+    form.add_field("file", fixture_bytes("imaginary.jpg"),
+                   filename="imaginary.jpg", content_type="image/jpeg")
+    return form
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+class TestPublicEndpoints:
+    def test_index_versions(self):
+        async def fn(client, _):
+            res = await client.get("/")
+            assert res.status == 200
+            body = await res.json()
+            assert "imaginary_tpu" in body and "jax" in body
+            assert res.headers["Server"].startswith("imaginary-tpu")
+
+        run(ServerOptions(), fn)
+
+    def test_health(self):
+        async def fn(client, _):
+            res = await client.get("/health")
+            body = await res.json()
+            assert res.status == 200
+            assert body["uptime"] >= 0 and "executor" in body
+
+        run(ServerOptions(), fn)
+
+    def test_form_html(self):
+        async def fn(client, _):
+            res = await client.get("/form")
+            text = await res.text()
+            assert res.status == 200
+            assert 'action="/resize' in text and "multipart/form-data" in text
+
+        run(ServerOptions(), fn)
+
+    def test_unknown_path_404(self):
+        async def fn(client, _):
+            res = await client.get("/bogus-path")
+            assert res.status == 404
+
+        run(ServerOptions(), fn)
+
+    def test_method_not_allowed(self):
+        async def fn(client, _):
+            res = await client.delete("/resize")
+            assert res.status == 405
+
+        run(ServerOptions(), fn)
+
+
+class TestImagePost:
+    def test_crop_multipart(self):
+        async def fn(client, _):
+            res = await client.post("/crop?width=300", data=multipart_jpg())
+            assert res.status == 200, await res.text()
+            assert res.headers["Content-Type"] == "image/jpeg"
+            body = await res.read()
+            assert oracle_size(body) == (300, 740)
+
+        run(ServerOptions(), fn)
+
+    def test_resize_raw_body(self):
+        async def fn(client, _):
+            res = await client.post(
+                "/resize?width=200&height=150",
+                data=fixture_bytes("imaginary.jpg"),
+                headers={"Content-Type": "image/jpeg"},
+            )
+            assert res.status == 200
+            assert oracle_size(await res.read()) == (200, 150)
+
+        run(ServerOptions(), fn)
+
+    def test_empty_body_400(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=200", data=b"",
+                                    headers={"Content-Type": "image/jpeg"})
+            assert res.status == 400
+
+        run(ServerOptions(), fn)
+
+    def test_non_image_payload_406(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=200", data=b"clearly not an image",
+                                    headers={"Content-Type": "image/jpeg"})
+            assert res.status == 406
+
+        run(ServerOptions(), fn)
+
+    def test_bad_param_400(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=bogus", data=multipart_jpg())
+            assert res.status == 400
+            body = await res.json()
+            assert "width" in body["message"]
+
+        run(ServerOptions(), fn)
+
+    def test_info(self):
+        async def fn(client, _):
+            res = await client.post("/info", data=multipart_jpg())
+            meta = await res.json()
+            assert meta["width"] == 550 and meta["height"] == 740
+
+        run(ServerOptions(), fn)
+
+    def test_pipeline(self):
+        async def fn(client, _):
+            ops = json.dumps([
+                {"operation": "crop", "params": {"width": 300, "height": 260}},
+                {"operation": "convert", "params": {"type": "webp"}},
+            ])
+            res = await client.post(f"/pipeline?operations={ops}", data=multipart_jpg())
+            assert res.status == 200, await res.text()
+            assert res.headers["Content-Type"] == "image/webp"
+            assert oracle_size(await res.read()) == (300, 260)
+
+        run(ServerOptions(), fn)
+
+
+class TestTypeAuto:
+    """ref: TestTypeAuto server_test.go:178-233."""
+
+    def test_accept_webp(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=100&type=auto", data=multipart_jpg(),
+                                    headers={"Accept": "image/webp,*/*"})
+            assert res.status == 200
+            assert res.headers["Content-Type"] == "image/webp"
+            assert res.headers["Vary"] == "Accept"
+
+        run(ServerOptions(), fn)
+
+    def test_chrome_accept_header(self):
+        chrome = "text/html,application/xhtml+xml,application/xml;q=0.9,image/avif,image/webp,image/apng,*/*;q=0.8"
+        async def fn(client, _):
+            res = await client.post("/resize?width=100&type=auto", data=multipart_jpg(),
+                                    headers={"Accept": chrome})
+            assert res.headers["Content-Type"] == "image/webp"
+            assert res.headers["Vary"] == "Accept"
+
+        run(ServerOptions(), fn)
+
+    def test_no_accept_keeps_source(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=100&type=auto", data=multipart_jpg())
+            assert res.headers["Content-Type"] == "image/jpeg"
+            assert res.headers["Vary"] == "Accept"
+
+        run(ServerOptions(), fn)
+
+    def test_invalid_type_400(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=100&type=bogus", data=multipart_jpg())
+            assert res.status == 400
+
+        run(ServerOptions(), fn)
+
+
+class TestResolutionGuard:
+    def test_too_many_pixels_422(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=100", data=multipart_jpg())
+            assert res.status == 422
+
+        run(ServerOptions(max_allowed_pixels=0.1), fn)
+
+
+class TestMountSource:
+    def test_fs_serving(self):
+        async def fn(client, _):
+            res = await client.get("/resize?file=imaginary.jpg&width=300")
+            assert res.status == 200
+            assert oracle_size(await res.read()) == (300, 404)
+
+        run(ServerOptions(mount=FIXTURES), fn)
+
+    def test_path_traversal_rejected(self):
+        async def fn(client, _):
+            res = await client.get("/resize?file=../../etc/passwd&width=100")
+            assert res.status == 400
+
+        run(ServerOptions(mount=FIXTURES), fn)
+
+    def test_missing_file_400(self):
+        async def fn(client, _):
+            res = await client.get("/resize?file=nope.jpg&width=100")
+            assert res.status == 400
+
+        run(ServerOptions(mount=FIXTURES), fn)
+
+    def test_get_without_sources_405(self):
+        async def fn(client, _):
+            res = await client.get("/resize?width=100")
+            assert res.status == 405
+
+        run(ServerOptions(), fn)
+
+
+class TestURLSource:
+    def test_remote_fetch(self):
+        from aiohttp import web
+
+        async def origin(request):
+            return web.Response(body=fixture_bytes("large.jpg"), content_type="image/jpeg")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=300")
+            assert res.status == 200
+            w, h = oracle_size(await res.read())
+            assert w == 300
+
+        run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
+
+    def test_origin_error_propagates(self):
+        from aiohttp import web
+
+        async def origin(request):
+            return web.Response(status=404, text="not here")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/gone.jpg&width=300")
+            assert res.status == 404
+
+        run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
+
+    def test_restricted_origin(self):
+        from aiohttp import web
+
+        async def origin(request):
+            return web.Response(body=fixture_bytes("large.jpg"), content_type="image/jpeg")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=300")
+            assert res.status == 400
+            body = await res.json()
+            assert "not allowed" in body["message"]
+
+        run(
+            ServerOptions(enable_url_source=True,
+                          allowed_origins=parse_origins("https://images.example.com")),
+            fn,
+            origin_handler=origin,
+        )
+
+    def test_invalid_url_400(self):
+        async def fn(client, _):
+            res = await client.get("/resize?url=not-a-url&width=300")
+            assert res.status == 400
+
+        run(ServerOptions(enable_url_source=True), fn)
+
+
+class TestAuthAndSignature:
+    def test_api_key(self):
+        async def fn(client, _):
+            res = await client.post("/crop?width=100", data=multipart_jpg())
+            assert res.status == 401
+            res = await client.post("/crop?width=100", data=multipart_jpg(),
+                                    headers={"API-Key": "s3cret"})
+            assert res.status == 200
+            res = await client.post("/crop?width=100&key=s3cret", data=multipart_jpg())
+            assert res.status == 200
+
+        run(ServerOptions(api_key="s3cret"), fn)
+
+    def test_url_signature(self):
+        key = "x" * 32
+
+        async def fn(client, _):
+            pairs = [("width", "100")]
+            sig = sign_url(key, "/crop", pairs)
+            res = await client.post(f"/crop?width=100&sign={sig}", data=multipart_jpg())
+            assert res.status == 200
+            res = await client.post("/crop?width=100&sign=invalid!!", data=multipart_jpg())
+            assert res.status == 400
+            bad = sign_url(key, "/crop", [("width", "999")])
+            res = await client.post(f"/crop?width=100&sign={bad}", data=multipart_jpg())
+            assert res.status == 403
+
+        run(ServerOptions(enable_url_signature=True, url_signature_key=key), fn)
+
+
+class TestMiddlewareExtras:
+    def test_throttle_429(self):
+        async def fn(client, _):
+            first = await client.post("/crop?width=50", data=multipart_jpg())
+            assert first.status == 200
+            second = await client.post("/crop?width=50", data=multipart_jpg())
+            assert second.status == 429
+            assert "Retry-After" in second.headers
+
+        run(ServerOptions(concurrency=1, burst=0), fn)
+
+    def test_disabled_endpoint_501(self):
+        async def fn(client, _):
+            res = await client.post("/blur?sigma=3", data=multipart_jpg())
+            assert res.status == 501
+            res = await client.post("/crop?width=50", data=multipart_jpg())
+            assert res.status == 200
+
+        run(ServerOptions(endpoints=("blur",)), fn)
+
+    def test_cache_headers(self):
+        async def fn(client, _):
+            res = await client.get("/resize?file=imaginary.jpg&width=100")
+            assert res.headers["Cache-Control"] == "public, s-maxage=300, max-age=300, no-transform"
+            assert "Expires" in res.headers
+            # public paths excluded
+            res = await client.get("/health")
+            assert "Cache-Control" not in res.headers
+
+        run(ServerOptions(mount=FIXTURES, http_cache_ttl=300), fn)
+
+    def test_no_cache_ttl_zero(self):
+        async def fn(client, _):
+            res = await client.get("/resize?file=imaginary.jpg&width=100")
+            assert res.headers["Cache-Control"] == "private, no-cache, no-store, must-revalidate"
+
+        run(ServerOptions(mount=FIXTURES, http_cache_ttl=0), fn)
+
+    def test_cors_headers(self):
+        async def fn(client, _):
+            res = await client.post("/crop?width=50", data=multipart_jpg())
+            assert res.headers["Access-Control-Allow-Origin"] == "*"
+
+        run(ServerOptions(cors=True), fn)
+
+    def test_return_size_headers(self):
+        async def fn(client, _):
+            res = await client.post("/crop?width=120&height=90", data=multipart_jpg())
+            assert res.headers["Image-Width"] == "120"
+            assert res.headers["Image-Height"] == "90"
+
+        run(ServerOptions(return_size=True), fn)
+
+
+class TestPlaceholder:
+    def test_placeholder_on_error(self):
+        async def fn(client, _):
+            # GET with no source configured would 405; use a failing decode
+            res = await client.post("/resize?width=120&height=90", data=b"not an image",
+                                    headers={"Content-Type": "image/jpeg"})
+            assert res.status == 406  # original error status preserved
+            assert res.headers["Content-Type"] == "image/jpeg"
+            assert "Error" in res.headers
+            assert oracle_size(await res.read()) == (120, 90)
+
+        run(ServerOptions(enable_placeholder=True), fn)
+
+    def test_placeholder_custom_status(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=60&height=60", data=b"junk",
+                                    headers={"Content-Type": "image/jpeg"})
+            assert res.status == 202
+
+        run(ServerOptions(enable_placeholder=True, placeholder_status=202), fn)
+
+
+class TestPathPrefix:
+    def test_prefixed_routes(self):
+        async def fn(client, _):
+            res = await client.post("/api/v1/crop?width=50", data=multipart_jpg())
+            assert res.status == 200
+            res = await client.get("/api/v1/health")
+            assert res.status == 200
+
+        run(ServerOptions(path_prefix="/api/v1"), fn)
