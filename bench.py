@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Headline benchmark: /resize of a 1080p JPEG, end-to-end.
+
+Measures the full request work — JPEG decode -> resize to 300x200 ->
+JPEG encode — through (a) this framework's path (host codecs + micro-batched
+jit-compiled TPU chain) and (b) the CPU baseline: OpenCV's native C++
+decode/INTER_AREA-resize/encode loop, the same libjpeg-turbo-class stack
+libvips uses (BASELINE.md: the reference's published numbers are 2015-era
+and unusable; the baseline is re-measured on identical hardware).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Supplementary detail goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _make_1080p_jpeg() -> bytes:
+    import cv2
+
+    rng = np.random.default_rng(7)
+    yy, xx = np.mgrid[0:1080, 0:1920]
+    img = np.stack(
+        [
+            (xx * 255 / 1919).astype(np.uint8),
+            (yy * 255 / 1079).astype(np.uint8),
+            ((xx + yy) % 256).astype(np.uint8),
+        ],
+        axis=-1,
+    )
+    for _ in range(12):
+        x0, y0 = int(rng.integers(0, 1800)), int(rng.integers(0, 1000))
+        img[y0 : y0 + 80, x0 : x0 + 120] = rng.integers(0, 256, 3)
+    ok, out = cv2.imencode(".jpg", img, [int(cv2.IMWRITE_JPEG_QUALITY), 88])
+    assert ok
+    return out.tobytes()
+
+
+def _run_threaded(fn, n_threads: int, duration: float) -> float:
+    """Run fn() in a loop across threads for `duration`s; returns ops/sec."""
+    stop = time.monotonic() + duration
+    counts = [0] * n_threads
+
+    def worker(i):
+        while time.monotonic() < stop:
+            fn()
+            counts[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    return sum(counts) / elapsed
+
+
+def bench_ours(buf: bytes, n_threads: int, duration: float) -> float:
+    from imaginary_tpu import codecs
+    from imaginary_tpu.codecs import EncodeOptions
+    from imaginary_tpu.engine import Executor, ExecutorConfig
+    from imaginary_tpu.imgtype import ImageType
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops.plan import plan_operation
+
+    executor = Executor(ExecutorConfig(window_ms=2.0, max_batch=8))
+    opts = ImageOptions(width=300, height=200)
+
+    def one():
+        d = codecs.decode(buf)
+        plan = plan_operation("resize", opts, d.array.shape[0], d.array.shape[1],
+                              d.orientation, d.array.shape[2])
+        out = executor.process(d.array, plan)
+        codecs.encode(out, EncodeOptions(type=ImageType.JPEG))
+
+    # warmup: compile every batch size the power-of-two padding can produce,
+    # so no XLA compile lands inside the timed window
+    d0 = codecs.decode(buf)
+    plan0 = plan_operation("resize", opts, d0.array.shape[0], d0.array.shape[1],
+                           d0.orientation, d0.array.shape[2])
+    for bs in (1, 2, 4, 8):
+        futs = [executor.submit(d0.array, plan0) for _ in range(bs)]
+        for f in futs:
+            f.result(timeout=300)
+    print(f"[bench] warmup done, backend={codecs.backend_name()}", file=sys.stderr)
+    rate = _run_threaded(one, n_threads, duration)
+    executor.shutdown()
+    return rate
+
+
+def bench_baseline(buf: bytes, n_threads: int, duration: float) -> float:
+    import cv2
+
+    data = np.frombuffer(buf, np.uint8)
+
+    def one():
+        a = cv2.imdecode(data, cv2.IMREAD_COLOR)
+        r = cv2.resize(a, (300, 200), interpolation=cv2.INTER_AREA)
+        cv2.imencode(".jpg", r, [int(cv2.IMWRITE_JPEG_QUALITY), 80])
+
+    one()
+    return _run_threaded(one, n_threads, duration)
+
+
+def _probe_accelerator(timeout: float = 90.0) -> bool:
+    """Check device liveness in a subprocess (the TPU tunnel can hang
+    indefinitely; a hung bench is worse than a CPU bench)."""
+    import subprocess
+
+    code = "import jax; jax.devices(); import jax.numpy as jnp; (jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    cpus = os.cpu_count() or 1
+    n_threads = int(os.environ.get("BENCH_THREADS", str(max(4, cpus))))
+
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if not platform and not _probe_accelerator():
+        print("[bench] accelerator unreachable; falling back to CPU JAX", file=sys.stderr)
+        platform = "cpu"
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    buf = _make_1080p_jpeg()
+    print(f"[bench] 1080p jpeg = {len(buf)} bytes, threads={n_threads}, "
+          f"duration={duration}s, cpus={cpus}", file=sys.stderr)
+
+    ours = bench_ours(buf, n_threads, duration)
+    print(f"[bench] imaginary-tpu: {ours:.2f} req/s", file=sys.stderr)
+
+    base = bench_baseline(buf, n_threads, duration)
+    print(f"[bench] cpu baseline (cv2): {base:.2f} req/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "resize_1080p_jpeg_e2e_throughput",
+        "value": round(ours, 2),
+        "unit": "req/sec",
+        "vs_baseline": round(ours / base, 3) if base > 0 else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -87,7 +87,9 @@ class CodecError(ImageError):
 
 
 def _backend():
-    """Pick the codec backend once, lazily (native if built, else PIL)."""
+    """Pick the codec backend once, lazily.
+
+    Preference: native C++ extension > cv2 (fast C++ codecs) > PIL."""
     global _BACKEND
     if _BACKEND is None:
         try:
@@ -95,11 +97,14 @@ def _backend():
 
             if native_backend.available():
                 _BACKEND = native_backend
-            else:  # pragma: no cover - depends on build environment
-                from imaginary_tpu.codecs import pil_backend
-
-                _BACKEND = pil_backend
         except Exception:  # pragma: no cover
+            pass
+    if _BACKEND is None:
+        try:
+            from imaginary_tpu.codecs import cv2_backend
+
+            _BACKEND = cv2_backend
+        except Exception:  # pragma: no cover - cv2 not installed
             from imaginary_tpu.codecs import pil_backend
 
             _BACKEND = pil_backend

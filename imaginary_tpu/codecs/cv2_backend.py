@@ -1,0 +1,94 @@
+"""OpenCV-based codec backend (fast host path).
+
+cv2's imdecode/imencode (libjpeg-turbo/libpng/libwebp under a thin C++
+layer) decodes ~2x faster than PIL on this class of hardware. JPEG/PNG/WEBP
+pixels go through cv2; GIF/TIFF, palette PNG output, and interlace/
+progressive encoding fall back to the PIL backend; EXIF orientation and
+metadata probing use PIL's header-only parse (no pixel decode).
+"""
+
+from __future__ import annotations
+
+import io
+
+import cv2
+import numpy as np
+from PIL import Image
+
+from imaginary_tpu.codecs import CodecError, DecodedImage, EncodeOptions, ImageMetadata
+from imaginary_tpu.codecs import pil_backend
+from imaginary_tpu.imgtype import ImageType
+
+NAME = "cv2"
+
+_CV2_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
+_EXT = {ImageType.JPEG: ".jpg", ImageType.PNG: ".png", ImageType.WEBP: ".webp"}
+
+
+def _header_orientation(buf: bytes) -> int:
+    """EXIF orientation from the header only (PIL defers pixel decode);
+    parse logic shared with the PIL backend."""
+    try:
+        return pil_backend._orientation(Image.open(io.BytesIO(buf)))
+    except Exception:
+        return 0
+
+
+def decode(buf: bytes, t: ImageType) -> DecodedImage:
+    if t not in _CV2_TYPES:
+        return pil_backend.decode(buf, t)
+    data = np.frombuffer(buf, np.uint8)
+    arr = cv2.imdecode(data, cv2.IMREAD_UNCHANGED | cv2.IMREAD_IGNORE_ORIENTATION)
+    if arr is None:
+        # cv2 gives no diagnostics; let PIL either decode it or explain
+        return pil_backend.decode(buf, t)
+    if arr.ndim == 2:
+        arr = cv2.cvtColor(arr, cv2.COLOR_GRAY2RGB)
+        has_alpha = False
+    elif arr.shape[2] == 4:
+        arr = cv2.cvtColor(arr, cv2.COLOR_BGRA2RGBA)
+        has_alpha = True
+    else:
+        arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+        has_alpha = False
+    if arr.dtype != np.uint8:  # 16-bit PNG etc.
+        arr = (arr.astype(np.float32) / 257.0 + 0.5).astype(np.uint8)
+    return DecodedImage(
+        array=np.ascontiguousarray(arr),
+        type=t,
+        orientation=_header_orientation(buf),  # JPEG/WEBP/PNG can all carry EXIF
+        has_alpha=has_alpha,
+    )
+
+
+def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
+    t = opts.type
+    if t not in _CV2_TYPES or opts.palette or opts.interlace:
+        return pil_backend.encode(arr, opts)
+    if arr.shape[2] == 1:
+        bgr = cv2.cvtColor(arr[:, :, 0], cv2.COLOR_GRAY2BGR)
+    elif arr.shape[2] == 4:
+        if t is ImageType.JPEG:
+            # flatten onto black (libvips' JPEG alpha handling)
+            a = arr[:, :, 3:4].astype(np.float32) / 255.0
+            rgb = (arr[:, :, :3].astype(np.float32) * a + 0.5).astype(np.uint8)
+            bgr = cv2.cvtColor(rgb, cv2.COLOR_RGB2BGR)
+        else:
+            bgr = cv2.cvtColor(arr, cv2.COLOR_RGBA2BGRA)
+    else:
+        bgr = cv2.cvtColor(arr, cv2.COLOR_RGB2BGR)
+    params = []
+    if t is ImageType.JPEG:
+        params = [cv2.IMWRITE_JPEG_QUALITY, opts.effective_quality()]
+    elif t is ImageType.WEBP:
+        params = [cv2.IMWRITE_WEBP_QUALITY, opts.effective_quality()]
+    elif t is ImageType.PNG:
+        params = [cv2.IMWRITE_PNG_COMPRESSION, opts.effective_compression()]
+    ok, out = cv2.imencode(_EXT[t], bgr, params)
+    if not ok:
+        raise CodecError(f"Cannot encode image as {t.value}", 400)
+    return out.tobytes()
+
+
+def probe(buf: bytes, t: ImageType) -> ImageMetadata:
+    return pil_backend.probe(buf, t)
