@@ -1,0 +1,21 @@
+# imaginary-tpu build/test targets (role of the reference's Makefile)
+
+.PHONY: all native test bench serve clean
+
+all: native test
+
+native:
+	python -m imaginary_tpu.native.build
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+serve:
+	python -m imaginary_tpu --port 9000 --enable-url-source
+
+clean:
+	rm -f imaginary_tpu/native/_imaginary_codecs*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

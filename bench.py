@@ -130,6 +130,21 @@ def main():
     cpus = os.cpu_count() or 1
     n_threads = int(os.environ.get("BENCH_THREADS", str(max(4, cpus))))
 
+    # build the native codec extension if missing (gitignored artifact)
+    import glob
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if not glob.glob(os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs*.so")):
+        try:
+            r = subprocess.run([sys.executable, "-m", "imaginary_tpu.native.build"],
+                               timeout=180, capture_output=True, cwd=root)
+            if r.returncode != 0:
+                print(f"[bench] native build failed ({r.returncode}); using fallback codecs",
+                      file=sys.stderr)
+        except Exception as e:
+            print(f"[bench] native build error: {e}; using fallback codecs", file=sys.stderr)
+
     platform = os.environ.get("BENCH_PLATFORM", "")
     if not platform and not _probe_accelerator():
         print("[bench] accelerator unreachable; falling back to CPU JAX", file=sys.stderr)

@@ -1,9 +1,10 @@
 """Native C++ codec backend shim.
 
-Wraps the `_imaginary_codecs` C extension (imaginary_tpu/native/codecs.cpp,
-built over libjpeg/libpng/libwebp) when it has been compiled; `available()`
-gates selection in codecs.__init__. Until the extension is built this module
-reports unavailable and the PIL backend serves.
+Wraps the `_imaginary_codecs` extension (imaginary_tpu/native/codecs.cpp —
+libjpeg/libpng/libwebp with the GIL released) when built via
+`python -m imaginary_tpu.native.build`. Formats the extension doesn't cover
+(GIF/TIFF, palette/interlace output) delegate to the PIL backend; probing
+delegates for metadata richness (ICC/space) with native fallback.
 """
 
 from __future__ import annotations
@@ -16,8 +17,8 @@ from imaginary_tpu.imgtype import ImageType
 NAME = "native"
 
 try:
-    import _imaginary_codecs as _ext  # built by imaginary_tpu/native/build.py
-except ImportError:  # pragma: no cover - depends on build step
+    from imaginary_tpu.native import _imaginary_codecs as _ext
+except ImportError:  # pragma: no cover - extension not built
     _ext = None
 
 
@@ -25,56 +26,61 @@ def available() -> bool:
     return _ext is not None
 
 
-_DECODABLE = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
+_NATIVE_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
 
 
 def decode(buf: bytes, t: ImageType) -> DecodedImage:
-    if t not in _DECODABLE:
+    if t not in _NATIVE_TYPES:
         from imaginary_tpu.codecs import pil_backend
 
         return pil_backend.decode(buf, t)
     try:
-        arr, orientation, has_alpha = _ext.decode(buf, t.value)
+        pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value)
     except Exception as e:
         raise CodecError(f"Cannot decode image: {e}", 400) from None
-    return DecodedImage(array=np.asarray(arr), type=t, orientation=orientation, has_alpha=bool(has_alpha))
+    # the extension always emits 3- or 4-channel RGB(A)
+    arr = np.frombuffer(pixels, dtype=np.uint8).reshape(h, w, c)
+    return DecodedImage(array=arr, type=t, orientation=orientation, has_alpha=bool(has_alpha))
 
 
 def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
-    if opts.type not in _DECODABLE:
+    t = opts.type
+    # palette output needs PIL's quantizer; interlace maps to progressive
+    # JPEG natively (interlaced-PNG writing exists in no available backend)
+    if t not in _NATIVE_TYPES or opts.palette:
         from imaginary_tpu.codecs import pil_backend
 
         return pil_backend.encode(arr, opts)
+    arr = np.ascontiguousarray(arr)
+    h, w, c = arr.shape
     try:
+        # 'y*' takes the array via the buffer protocol: no tobytes() copy
         return _ext.encode(
-            np.ascontiguousarray(arr),
-            opts.type.value,
-            opts.effective_quality(),
-            opts.effective_compression(),
-            bool(opts.interlace),
+            arr, h, w, c, t.value,
+            opts.effective_quality(), opts.effective_compression(),
+            1 if opts.interlace else 0,
         )
     except Exception as e:
         raise CodecError(f"Cannot encode image: {e}", 400) from None
 
 
 def probe(buf: bytes, t: ImageType) -> ImageMetadata:
-    if t not in _DECODABLE or _ext is None or not hasattr(_ext, "probe"):
-        from imaginary_tpu.codecs import pil_backend
+    # PIL's probe is header-only (no pixel decode) and carries richer
+    # metadata (colour space, ICC flag); the native probe is the fallback.
+    from imaginary_tpu.codecs import pil_backend
 
+    if t not in _NATIVE_TYPES:
         return pil_backend.probe(buf, t)
     try:
-        w, h, channels, has_alpha, orientation = _ext.probe(buf, t.value)
-    except Exception:
-        from imaginary_tpu.codecs import pil_backend
-
         return pil_backend.probe(buf, t)
+    except CodecError:
+        pass
+    try:
+        w, h, c, has_alpha, orientation = _ext.probe(buf, t.value)
+    except Exception as e:
+        raise CodecError(f"Cannot retrieve image metadata: {e}", 400) from None
     return ImageMetadata(
-        width=w,
-        height=h,
-        type=t.value,
-        space="srgb",
-        has_alpha=bool(has_alpha),
-        has_profile=False,
-        channels=channels,
-        orientation=orientation,
+        width=w, height=h, type=t.value, space="srgb",
+        has_alpha=bool(has_alpha), has_profile=False,
+        channels=c, orientation=orientation,
     )

@@ -97,7 +97,12 @@ def probe(buf: bytes, t: ImageType) -> ImageMetadata:
     if t is ImageType.SVG:
         # PIL cannot rasterize SVG; report what the bytes tell us.
         return ImageMetadata(0, 0, "svg", "srgb", False, False, 3, 0)
-    im = _open(buf)
+    # header-only: Image.open parses metadata lazily; no im.load() here, so
+    # probing never pays a pixel decode
+    try:
+        im = Image.open(io.BytesIO(buf))
+    except Exception as e:
+        raise CodecError(f"Cannot decode image: {e}", 400) from None
     has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)
     channels = len(im.getbands())
     return ImageMetadata(

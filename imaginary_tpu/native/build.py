@@ -1,0 +1,41 @@
+"""Build the native codec extension in-place.
+
+Usage: python -m imaginary_tpu.native.build  (or `make native`).
+Compiles codecs.cpp against system libjpeg/libpng/libwebp into
+imaginary_tpu/native/_imaginary_codecs.*.so; codecs/native_backend.py picks
+it up on next interpreter start.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose: bool = True) -> str:
+    src = os.path.join(HERE, "codecs.cpp")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(HERE, "_imaginary_codecs" + suffix)
+    include = sysconfig.get_path("include")
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}",
+        src, "-o", out,
+        "-ljpeg", "-lpng", "-lwebp",
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    sys.path.insert(0, HERE)
+    import _imaginary_codecs  # noqa: F401  (smoke import)
+
+    print(f"built {path}")

@@ -1,0 +1,423 @@
+// Native host codec layer: JPEG/PNG/WEBP decode+encode + EXIF orientation.
+//
+// Plays the role of the reference's external native stack (bimg -> libvips
+// -> libjpeg-turbo/libpng/libwebp; SURVEY.md section 2.12) for the host
+// side of the TPU pipeline. Built directly on the CPython C API (no
+// pybind11 in this image). All codec work runs with the GIL RELEASED, so
+// Python worker threads decode/encode on real cores concurrently — the
+// property the Python-only backends cannot provide.
+//
+// Interface (module _imaginary_codecs):
+//   decode(bytes, fmt: str)  -> (pixels: bytes, h, w, c, orientation, has_alpha)
+//   encode(buffer, h, w, c, fmt: str, quality, compression, progressive) -> bytes
+//   probe(bytes, fmt: str)   -> (w, h, c, has_alpha, orientation)
+// The Python shim (codecs/native_backend.py) wraps pixels in numpy arrays.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <csetjmp>
+#include <string>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+#include <webp/decode.h>
+#include <webp/encode.h>
+
+namespace {
+
+// ---------------------------------------------------------------- EXIF ------
+
+// Minimal EXIF Orientation (tag 0x0112) scan over a JPEG APP1 segment.
+uint32_t rd16(const uint8_t* p, bool le) {
+  return le ? (p[0] | (p[1] << 8)) : ((p[0] << 8) | p[1]);
+}
+uint32_t rd32(const uint8_t* p, bool le) {
+  return le ? (p[0] | (p[1] << 8) | (p[2] << 16) | ((uint32_t)p[3] << 24))
+            : (((uint32_t)p[0] << 24) | (p[1] << 16) | (p[2] << 8) | p[3]);
+}
+
+int exif_orientation(const uint8_t* buf, size_t len) {
+  if (len < 4 || buf[0] != 0xFF || buf[1] != 0xD8) return 0;
+  size_t i = 2;
+  while (i + 4 <= len) {
+    if (buf[i] != 0xFF) break;
+    uint8_t marker = buf[i + 1];
+    if (marker == 0xD8 || (marker >= 0xD0 && marker <= 0xD9)) { i += 2; continue; }
+    size_t seglen = ((size_t)buf[i + 2] << 8) | buf[i + 3];
+    if (seglen < 2 || i + 2 + seglen > len) break;
+    if (marker == 0xE1 && seglen >= 10 &&
+        std::memcmp(buf + i + 4, "Exif\0\0", 6) == 0) {
+      const uint8_t* t = buf + i + 10;       // TIFF header
+      size_t tlen = seglen - 8;
+      if (tlen < 8) return 0;
+      bool le;
+      if (t[0] == 'I' && t[1] == 'I') le = true;
+      else if (t[0] == 'M' && t[1] == 'M') le = false;
+      else return 0;
+      uint32_t ifd = rd32(t + 4, le);
+      if (ifd + 2 > tlen) return 0;
+      uint32_t n = rd16(t + ifd, le);
+      for (uint32_t e = 0; e < n; e++) {
+        size_t off = ifd + 2 + 12 * (size_t)e;
+        if (off + 12 > tlen) return 0;
+        if (rd16(t + off, le) == 0x0112) {
+          uint32_t v = rd16(t + off + 8, le);
+          return (v <= 8) ? (int)v : 0;
+        }
+      }
+      return 0;
+    }
+    if (marker == 0xDA) break;  // start of scan: no EXIF past here
+    i += 2 + seglen;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- JPEG ------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* e = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
+}
+
+bool jpeg_decode(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                 int* w, int* h, int* c, std::string* err) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    *err = jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  *c = 3;
+  out->resize((size_t)(*w) * (*h) * 3);
+  size_t stride = (size_t)(*w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool jpeg_probe(const uint8_t* buf, size_t len, int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  *w = cinfo.image_width;
+  *h = cinfo.image_height;
+  *c = cinfo.num_components;
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool jpeg_encode(const uint8_t* pix, int w, int h, int c, int quality,
+                 bool progressive, std::vector<uint8_t>* out, std::string* err) {
+  // c must be 1 or 3 (alpha pre-flattened by caller)
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  unsigned char* mem = nullptr;
+  unsigned long memlen = 0;
+  if (setjmp(jerr.jb)) {
+    *err = jerr.msg;
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &memlen);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = c;
+  cinfo.in_color_space = (c == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  if (progressive) jpeg_simple_progression(&cinfo);
+  jpeg_start_compress(&cinfo, TRUE);
+  size_t stride = (size_t)w * c;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = const_cast<uint8_t*>(pix) + stride * cinfo.next_scanline;
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  out->assign(mem, mem + memlen);
+  jpeg_destroy_compress(&cinfo);
+  free(mem);
+  return true;
+}
+
+// ----------------------------------------------------------------- PNG ------
+
+bool png_decode_buf(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                    int* w, int* h, int* c, std::string* err) {
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, buf, len)) {
+    *err = img.message;
+    return false;
+  }
+  bool alpha = (img.format & PNG_FORMAT_FLAG_ALPHA) != 0;
+  img.format = alpha ? PNG_FORMAT_RGBA : PNG_FORMAT_RGB;
+  *c = alpha ? 4 : 3;
+  *w = img.width;
+  *h = img.height;
+  out->resize(PNG_IMAGE_SIZE(img));
+  if (!png_image_finish_read(&img, nullptr, out->data(), 0, nullptr)) {
+    *err = img.message;
+    return false;
+  }
+  return true;
+}
+
+bool png_probe_buf(const uint8_t* buf, size_t len, int* w, int* h, int* c) {
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, buf, len)) return false;
+  *w = img.width;
+  *h = img.height;
+  *c = (img.format & PNG_FORMAT_FLAG_ALPHA) ? 4 : 3;
+  png_image_free(&img);
+  return true;
+}
+
+bool png_encode_buf(const uint8_t* pix, int w, int h, int c,
+                    std::vector<uint8_t>* out, std::string* err) {
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  img.width = w;
+  img.height = h;
+  img.format = (c == 4) ? PNG_FORMAT_RGBA : (c == 1 ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB);
+  png_alloc_size_t size = 0;
+  if (!png_image_write_to_memory(&img, nullptr, &size, 0, pix, 0, nullptr)) {
+    *err = img.message;
+    return false;
+  }
+  out->resize(size);
+  if (!png_image_write_to_memory(&img, out->data(), &size, 0, pix, 0, nullptr)) {
+    *err = img.message;
+    return false;
+  }
+  out->resize(size);
+  return true;
+}
+
+// ---------------------------------------------------------------- WEBP ------
+
+bool webp_decode_buf(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                     int* w, int* h, int* c, std::string* err) {
+  WebPBitstreamFeatures feat;
+  if (WebPGetFeatures(buf, len, &feat) != VP8_STATUS_OK) {
+    *err = "invalid webp";
+    return false;
+  }
+  *w = feat.width;
+  *h = feat.height;
+  *c = feat.has_alpha ? 4 : 3;
+  size_t stride = (size_t)(*w) * (*c);
+  out->resize(stride * (*h));
+  uint8_t* res = feat.has_alpha
+      ? WebPDecodeRGBAInto(buf, len, out->data(), out->size(), (int)stride)
+      : WebPDecodeRGBInto(buf, len, out->data(), out->size(), (int)stride);
+  if (!res) {
+    *err = "webp decode failed";
+    return false;
+  }
+  return true;
+}
+
+bool webp_encode_buf(const uint8_t* pix, int w, int h, int c, int quality,
+                     std::vector<uint8_t>* out, std::string* err) {
+  uint8_t* mem = nullptr;
+  size_t n = (c == 4)
+      ? WebPEncodeRGBA(pix, w, h, w * 4, (float)quality, &mem)
+      : WebPEncodeRGB(pix, w, h, w * 3, (float)quality, &mem);
+  if (!n || !mem) {
+    *err = "webp encode failed";
+    return false;
+  }
+  out->assign(mem, mem + n);
+  WebPFree(mem);
+  return true;
+}
+
+// -------------------------------------------------------------- Python ------
+
+PyObject* py_decode(PyObject*, PyObject* args) {
+  Py_buffer view;
+  const char* fmt;
+  if (!PyArg_ParseTuple(args, "y*s", &view, &fmt)) return nullptr;
+  const uint8_t* buf = static_cast<const uint8_t*>(view.buf);
+  size_t len = view.len;
+  std::vector<uint8_t> out;
+  int w = 0, h = 0, c = 0, orientation = 0;
+  std::string err;
+  bool ok = false;
+  std::string f(fmt);
+  Py_BEGIN_ALLOW_THREADS
+  if (f == "jpeg") {
+    ok = jpeg_decode(buf, len, &out, &w, &h, &c, &err);
+    if (ok) orientation = exif_orientation(buf, len);
+  } else if (f == "png") {
+    ok = png_decode_buf(buf, len, &out, &w, &h, &c, &err);
+  } else if (f == "webp") {
+    ok = webp_decode_buf(buf, len, &out, &w, &h, &c, &err);
+  } else {
+    err = "unsupported format: " + f;
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, err.empty() ? "decode failed" : err.c_str());
+    return nullptr;
+  }
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(out.data()), (Py_ssize_t)out.size());
+  if (!bytes) return nullptr;
+  return Py_BuildValue("(Niiiii)", bytes, h, w, c, orientation, (c == 4) ? 1 : 0);
+}
+
+PyObject* py_encode(PyObject*, PyObject* args) {
+  Py_buffer view;
+  int w, h, c, quality, compression, progressive;
+  const char* fmt;
+  if (!PyArg_ParseTuple(args, "y*iiisiii", &view, &h, &w, &c, &fmt,
+                        &quality, &compression, &progressive))
+    return nullptr;
+  if ((Py_ssize_t)((size_t)w * h * c) != view.len) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_ValueError, "buffer size does not match h*w*c");
+    return nullptr;
+  }
+  const uint8_t* pix = static_cast<const uint8_t*>(view.buf);
+  std::vector<uint8_t> out;
+  std::vector<uint8_t> flat;
+  std::string err;
+  bool ok = false;
+  std::string f(fmt);
+  Py_BEGIN_ALLOW_THREADS
+  if (f == "jpeg") {
+    const uint8_t* src = pix;
+    int cc = c;
+    if (c == 4) {  // flatten alpha onto black (libvips JPEG behavior)
+      flat.resize((size_t)w * h * 3);
+      for (size_t i = 0, n = (size_t)w * h; i < n; i++) {
+        uint32_t a = pix[i * 4 + 3];
+        flat[i * 3 + 0] = (uint8_t)((pix[i * 4 + 0] * a + 127) / 255);
+        flat[i * 3 + 1] = (uint8_t)((pix[i * 4 + 1] * a + 127) / 255);
+        flat[i * 3 + 2] = (uint8_t)((pix[i * 4 + 2] * a + 127) / 255);
+      }
+      src = flat.data();
+      cc = 3;
+    }
+    ok = jpeg_encode(src, w, h, cc, quality, progressive != 0, &out, &err);
+  } else if (f == "png") {
+    ok = png_encode_buf(pix, w, h, c, &out, &err);
+  } else if (f == "webp") {
+    const uint8_t* src = pix;
+    int cc = c;
+    if (c == 1) {
+      flat.resize((size_t)w * h * 3);
+      for (size_t i = 0, n = (size_t)w * h; i < n; i++)
+        flat[i * 3] = flat[i * 3 + 1] = flat[i * 3 + 2] = pix[i];
+      src = flat.data();
+      cc = 3;
+    }
+    ok = webp_encode_buf(src, w, h, cc, quality, &out, &err);
+  } else {
+    err = "unsupported format: " + f;
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, err.empty() ? "encode failed" : err.c_str());
+    return nullptr;
+  }
+  return PyBytes_FromStringAndSize(reinterpret_cast<const char*>(out.data()),
+                                   (Py_ssize_t)out.size());
+}
+
+PyObject* py_probe(PyObject*, PyObject* args) {
+  Py_buffer view;
+  const char* fmt;
+  if (!PyArg_ParseTuple(args, "y*s", &view, &fmt)) return nullptr;
+  const uint8_t* buf = static_cast<const uint8_t*>(view.buf);
+  size_t len = view.len;
+  int w = 0, h = 0, c = 0, orientation = 0;
+  bool ok = false;
+  std::string f(fmt);
+  Py_BEGIN_ALLOW_THREADS
+  if (f == "jpeg") {
+    ok = jpeg_probe(buf, len, &w, &h, &c);
+    if (ok) orientation = exif_orientation(buf, len);
+  } else if (f == "png") {
+    ok = png_probe_buf(buf, len, &w, &h, &c);
+  } else if (f == "webp") {
+    WebPBitstreamFeatures feat;
+    if (WebPGetFeatures(buf, len, &feat) == VP8_STATUS_OK) {
+      w = feat.width; h = feat.height; c = feat.has_alpha ? 4 : 3;
+      ok = true;
+    }
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, "probe failed");
+    return nullptr;
+  }
+  return Py_BuildValue("(iiiii)", w, h, c, (c == 4) ? 1 : 0, orientation);
+}
+
+PyMethodDef methods[] = {
+    {"decode", py_decode, METH_VARARGS,
+     "decode(bytes, fmt) -> (pixels, h, w, c, orientation, has_alpha)"},
+    {"encode", py_encode, METH_VARARGS,
+     "encode(buf, h, w, c, fmt, quality, compression, progressive) -> bytes"},
+    {"probe", py_probe, METH_VARARGS,
+     "probe(bytes, fmt) -> (w, h, c, has_alpha, orientation)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_imaginary_codecs",
+    "Native JPEG/PNG/WEBP codecs (GIL-released)", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__imaginary_codecs(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,92 @@
+"""Native C++ codec extension tests (built on demand; skipped only if the
+toolchain build fails)."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu.codecs import DecodedImage, EncodeOptions
+from imaginary_tpu.imgtype import ImageType
+from tests.conftest import fixture_bytes
+
+
+@pytest.fixture(scope="module")
+def native():
+    from imaginary_tpu.codecs import native_backend
+
+    if not native_backend.available():
+        try:
+            from imaginary_tpu.native.build import build
+
+            build(verbose=False)
+        except Exception as e:
+            pytest.skip(f"native build failed: {e}")
+        import importlib
+
+        importlib.reload(native_backend)
+        if not native_backend.available():
+            pytest.skip("native extension unavailable after build")
+    return native_backend
+
+
+def test_decode_matches_pil(native, testdata):
+    buf = fixture_bytes("imaginary.jpg")
+    d = native.decode(buf, ImageType.JPEG)
+    assert isinstance(d, DecodedImage)
+    assert d.array.shape == (740, 550, 3)
+    ref = np.asarray(Image.open(io.BytesIO(buf)).convert("RGB"), dtype=np.int16)
+    # same libjpeg family: expect near-identical pixels
+    assert np.abs(d.array.astype(np.int16) - ref).mean() < 2.0
+
+
+def test_exif_orientation(native, testdata):
+    d = native.decode(fixture_bytes("exif-orient-6.jpg"), ImageType.JPEG)
+    assert d.orientation == 6
+    assert d.array.shape[:2] == (300, 400)  # raw, unrotated
+
+
+@pytest.mark.parametrize("t", [ImageType.JPEG, ImageType.PNG, ImageType.WEBP])
+def test_roundtrip(native, t):
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, (60, 80, 3), dtype=np.uint8)
+    buf = native.encode(arr, EncodeOptions(type=t))
+    im = Image.open(io.BytesIO(buf))
+    assert im.size == (80, 60)
+
+
+def test_png_alpha_roundtrip(native):
+    arr = np.zeros((20, 30, 4), dtype=np.uint8)
+    arr[..., 1] = 200
+    arr[..., 3] = 128
+    buf = native.encode(arr, EncodeOptions(type=ImageType.PNG))
+    back = native.decode(buf, ImageType.PNG)
+    assert back.has_alpha
+    assert np.array_equal(back.array, arr)
+
+
+def test_jpeg_alpha_flattens_black(native):
+    arr = np.zeros((10, 10, 4), dtype=np.uint8)
+    arr[..., 0] = 255  # transparent red
+    buf = native.encode(arr, EncodeOptions(type=ImageType.JPEG))
+    back = np.asarray(Image.open(io.BytesIO(buf)).convert("RGB"))
+    assert back.mean() < 5
+
+
+def test_garbage_raises(native):
+    with pytest.raises(Exception):
+        native.decode(b"\xff\xd8\xffgarbage garbage", ImageType.JPEG)
+
+
+def test_probe(native, testdata):
+    m = native.probe(fixture_bytes("large.jpg"), ImageType.JPEG)
+    assert (m.width, m.height) == (1920, 1080)
+
+
+def test_progressive_jpeg(native):
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+    buf = native.encode(arr, EncodeOptions(type=ImageType.JPEG, interlace=True))
+    im = Image.open(io.BytesIO(buf))
+    assert im.info.get("progressive", 0) == 1
