@@ -1,0 +1,114 @@
+"""Pallas TPU kernels for the hot resample path.
+
+The einsum resample (stages.py) materializes per-batch sampling matrices
+[B, out, in] in HBM before the matmul. This kernel fuses weight generation
+into the matmul: for each output row tile, the [TILE, in] weight block is
+computed in VMEM from the dynamic (src, dst) sizes and immediately
+contracted against the image block on the MXU — HBM never sees a weight
+matrix. (See /opt/skills/guides/pallas_guide.md; grid over (batch, row
+tiles), scalar sizes in SMEM.)
+
+Opt-in via IMAGINARY_TPU_PALLAS=1 (stages.SampleSpec consults
+`use_pallas()`); interpret mode keeps it testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_EPS = 1e-6
+
+
+def use_pallas() -> bool:
+    if os.environ.get("IMAGINARY_TPU_PALLAS", "") != "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _weights_block(y0, tile, in_size, src, dst, kind: str):
+    """[tile, in_size] weight block for output rows y0..y0+tile (traced)."""
+    y = (y0 + jax.lax.iota(jnp.float32, tile))[:, None]
+    k = jax.lax.iota(jnp.float32, in_size)[None, :]
+    src = jnp.maximum(src, 1.0)
+    dst = jnp.maximum(dst, 1.0)
+    scale = dst / src
+    centre = (y + 0.5) / scale - 0.5
+    stretch = jnp.maximum(1.0, 1.0 / scale)
+    d = (k - centre) / stretch
+    ad = jnp.abs(d)
+    if kind == "lanczos3":
+        wts = jnp.where(ad < 3.0, jnp.sinc(d) * jnp.sinc(d / 3.0), 0.0)
+    elif kind == "linear":
+        wts = jnp.maximum(0.0, 1.0 - ad)
+    elif kind == "nearest":
+        wts = jnp.where((d >= -0.5) & (d < 0.5), 1.0, 0.0)
+    else:  # cubic (Catmull-Rom)
+        a = -0.5
+        w1 = (a + 2) * ad**3 - (a + 3) * ad**2 + 1
+        w2 = a * ad**3 - 5 * a * ad**2 + 8 * a * ad - 4 * a
+        wts = jnp.where(ad <= 1, w1, jnp.where(ad < 2, w2, 0.0))
+    valid = (k < src) & (y < dst)
+    wts = jnp.where(valid, wts, 0.0)
+    norm = jnp.sum(wts, axis=-1, keepdims=True)
+    return jnp.where(norm > _EPS, wts / jnp.maximum(norm, _EPS), 0.0)
+
+
+def _row_tile(out_size: int) -> int:
+    for t in (256, 128, 64, 32, 16, 8):
+        if out_size % t == 0:
+            return t
+    return out_size
+
+
+@functools.partial(jax.jit, static_argnames=("out_size", "kind", "interpret"))
+def resample_rows(x, src, dst, out_size: int, kind: str = "lanczos3",
+                  interpret: bool = False):
+    """Resample axis 1: [B, in_h, W, C] f32 -> [B, out_size, W, C].
+
+    src/dst: [B] f32 valid sizes (dynamic). Fused weights-in-VMEM matmul.
+    """
+    b, in_h, width, ch = x.shape
+    wc = width * ch
+    x2 = x.reshape(b, in_h, wc)
+    tile = _row_tile(out_size)
+
+    def kernel(src_ref, dst_ref, x_ref, o_ref):
+        bi = pl.program_id(0)
+        ti = pl.program_id(1)
+        wts = _weights_block(
+            (ti * tile).astype(jnp.float32), tile, in_h,
+            src_ref[bi], dst_ref[bi], kind,
+        )
+        o_ref[0] = jnp.dot(wts, x_ref[0], preferred_element_type=jnp.float32)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, out_size // tile),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, in_h, wc), lambda bi, ti: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile, wc), lambda bi, ti: (bi, ti, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, out_size, wc), jnp.float32),
+        interpret=interpret,
+    )(src, dst, x2)
+    return out.reshape(b, out_size, width, ch)
+
+
+def resample_2d(x, src_h, dst_h, src_w, dst_w, out_h: int, out_w: int,
+                kind: str = "lanczos3", interpret: bool = False):
+    """Separable 2-D resample via two fused row passes (W via transpose)."""
+    t = resample_rows(x, src_h, dst_h, out_h, kind, interpret)
+    t = jnp.transpose(t, (0, 2, 1, 3))
+    t = resample_rows(t, src_w, dst_w, out_w, kind, interpret)
+    return jnp.transpose(t, (0, 2, 1, 3))

@@ -88,10 +88,21 @@ class SampleSpec:
     kernel: str = "lanczos3"
 
     def apply(self, x, h, w, dyn):
-        wy = sample_matrix(self.out_hb, x.shape[1], h.astype(jnp.float32), dyn["dst_h"], self.kernel)
-        t = jnp.einsum("byk,bkwc->bywc", wy, x)
-        wx = sample_matrix(self.out_wb, x.shape[2], w.astype(jnp.float32), dyn["dst_w"], self.kernel)
-        out = jnp.einsum("bxw,bywc->byxc", wx, t)
+        from imaginary_tpu.ops.pallas_kernels import use_pallas
+
+        if use_pallas():
+            from imaginary_tpu.ops.pallas_kernels import resample_2d
+
+            out = resample_2d(
+                x, h.astype(jnp.float32), dyn["dst_h"],
+                w.astype(jnp.float32), dyn["dst_w"],
+                self.out_hb, self.out_wb, self.kernel,
+            )
+        else:
+            wy = sample_matrix(self.out_hb, x.shape[1], h.astype(jnp.float32), dyn["dst_h"], self.kernel)
+            t = jnp.einsum("byk,bkwc->bywc", wy, x)
+            wx = sample_matrix(self.out_wb, x.shape[2], w.astype(jnp.float32), dyn["dst_w"], self.kernel)
+            out = jnp.einsum("bxw,bywc->byxc", wx, t)
         return out, dyn["dst_h"].astype(jnp.int32), dyn["dst_w"].astype(jnp.int32)
 
 
