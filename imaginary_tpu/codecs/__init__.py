@@ -118,8 +118,13 @@ def backend_name() -> str:
     return _backend().NAME
 
 
-def decode(buf: bytes) -> DecodedImage:
+def decode(buf: bytes, shrink: int = 1) -> DecodedImage:
     """Decode bytes into an HWC uint8 array (C always 3 or 4).
+
+    shrink in {2, 4, 8} asks the decoder for 1/N-scale shrink-on-load
+    (JPEG DCT scaling; result dims are ceil(dim/N)). Other formats and
+    shrink=1 decode at full size. Callers use ops.plan.choose_decode_shrink
+    to pick a value that provably preserves output dimensions.
 
     Raises CodecError(400) for empty/undecodable input, and CodecError(406)
     for recognized-but-undecodable formats (svg/pdf/heif/avif need optional
@@ -129,7 +134,7 @@ def decode(buf: bytes) -> DecodedImage:
     if not buf:
         raise CodecError("Empty or unreadable image", 400)
     t = determine_image_type(buf)
-    return _backend().decode(buf, t)
+    return _backend().decode(buf, t, shrink)
 
 
 def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:

@@ -34,10 +34,25 @@ def _header_orientation(buf: bytes) -> int:
         return 0
 
 
-def decode(buf: bytes, t: ImageType) -> DecodedImage:
+_REDUCED = {2: cv2.IMREAD_REDUCED_COLOR_2, 4: cv2.IMREAD_REDUCED_COLOR_4,
+            8: cv2.IMREAD_REDUCED_COLOR_8}
+
+
+def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
     if t not in _CV2_TYPES:
         return pil_backend.decode(buf, t)
     data = np.frombuffer(buf, np.uint8)
+    if t is ImageType.JPEG and shrink in _REDUCED:
+        # shrink-on-load: libjpeg decodes at 1/N straight off the DCT.
+        # Decode stays RAW (no EXIF auto-rotation) — orientation is reported
+        # and applied by the op planner, like the full-decode path below.
+        arr = cv2.imdecode(data, _REDUCED[shrink] | cv2.IMREAD_IGNORE_ORIENTATION)
+        if arr is not None:
+            arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+            return DecodedImage(
+                array=np.ascontiguousarray(arr), type=t,
+                orientation=_header_orientation(buf), has_alpha=False,
+            )
     arr = cv2.imdecode(data, cv2.IMREAD_UNCHANGED | cv2.IMREAD_IGNORE_ORIENTATION)
     if arr is None:
         # cv2 gives no diagnostics; let PIL either decode it or explain

@@ -29,13 +29,18 @@ def available() -> bool:
 _NATIVE_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
 
 
-def decode(buf: bytes, t: ImageType) -> DecodedImage:
+def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
     if t not in _NATIVE_TYPES:
         from imaginary_tpu.codecs import pil_backend
 
-        return pil_backend.decode(buf, t)
+        return pil_backend.decode(buf, t, shrink)
+    denom = shrink if (t is ImageType.JPEG and shrink in (2, 4, 8)) else 1
     try:
-        pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value)
+        try:
+            pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value, denom)
+        except TypeError:
+            # older extension build without the scale argument
+            pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value)
     except Exception as e:
         raise CodecError(f"Cannot decode image: {e}", 400) from None
     # the extension always emits 3- or 4-channel RGB(A)

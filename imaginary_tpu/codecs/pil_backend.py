@@ -44,11 +44,26 @@ def _open(buf: bytes) -> Image.Image:
         raise CodecError(f"Cannot decode image: {e}", 400) from None
 
 
-def decode(buf: bytes, t: ImageType) -> DecodedImage:
+def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
     if t not in _DECODABLE:
         if t in (ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF):
             raise CodecError(f"Decoding {t.value} is not supported by this build", 406)
         raise CodecError("Unsupported media type", 406)
+    if t is ImageType.JPEG and shrink in (2, 4, 8):
+        try:
+            im = Image.open(io.BytesIO(buf))
+            orientation = _orientation(im)
+            # draft() switches the libjpeg decoder to 1/N DCT scaling
+            im.draft("RGB", (max(1, im.size[0] // shrink), max(1, im.size[1] // shrink)))
+            im.load()
+            if im.mode != "RGB":
+                im = im.convert("RGB")
+            arr = np.asarray(im, dtype=np.uint8)
+            return DecodedImage(array=arr, type=t, orientation=orientation, has_alpha=False)
+        except CodecError:
+            raise
+        except Exception:
+            pass  # fall through to the full decode
     im = _open(buf)
     orientation = _orientation(im)
     has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)

@@ -31,7 +31,8 @@ from imaginary_tpu.ops.plan import ImagePlan
 @dataclasses.dataclass
 class ExecutorConfig:
     window_ms: float = 3.0
-    max_batch: int = 8
+    max_batch: int = 16
+    max_inflight: int = 4  # batches launched but not yet fetched
     use_mesh: bool = False  # shard micro-batches over the device mesh
     n_devices: Optional[int] = None  # None = all devices
     spatial: int = 1  # spatial mesh axis size (sp sharding for huge images)
@@ -85,8 +86,17 @@ class Executor:
             self._sharding = batch_sharding(mesh)
             self._mesh_batch = mesh.devices.shape[0]
         self._running = True
+        # Launched-but-unfetched batches ride this bounded queue: the
+        # collector keeps dispatching (H2D + compute are cheap and async)
+        # while ONE fetch thread serially drains device->host readbacks —
+        # the link's readback path has a large fixed cost, low bandwidth,
+        # and degrades badly under concurrent fetches, so overlap comes
+        # from pipelining compute behind a single ordered D2H stream.
+        self._fetch_queue: queue_mod.Queue = queue_mod.Queue(maxsize=self.config.max_inflight)
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
+        self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher", daemon=True)
+        self._fetcher.start()
 
     # -- public API ------------------------------------------------------------
 
@@ -106,7 +116,11 @@ class Executor:
     def shutdown(self):
         self._running = False
         self._queue.put(None)
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=30)
+        # the collector enqueues the fetcher's sentinel itself, after its
+        # final drain — a shutdown-enqueued sentinel could overtake batches
+        # still being dispatched and strand their futures
+        self._fetcher.join(timeout=30)
 
     # -- collector -------------------------------------------------------------
 
@@ -125,6 +139,20 @@ class Executor:
                 pending.setdefault(got.key, []).append(got)
             except queue_mod.Empty:
                 pass
+            else:
+                # Drain the whole backlog before deciding what's due: under
+                # load (or after a blocking fetch-queue put) many items wait
+                # here, and taking one per wakeup would dispatch singleton
+                # batches the moment the window expires.
+                while True:
+                    try:
+                        more = self._queue.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if more is None:
+                        self._running = False
+                        break
+                    pending.setdefault(more.key, []).append(more)
             now = time.monotonic()
             due = [
                 k for k, items in pending.items()
@@ -135,9 +163,10 @@ class Executor:
                 for start in range(0, len(items), self.config.max_batch):
                     self._dispatch(items[start : start + self.config.max_batch])
             self.stats.queue_depth = self._queue.qsize() + sum(len(v) for v in pending.values())
-        # drain on shutdown
+        # drain on shutdown, then release the fetcher
         for items in pending.values():
             self._dispatch(items)
+        self._fetch_queue.put(None)
 
     def _dispatch(self, items: list):
         n = len(items)
@@ -156,7 +185,7 @@ class Executor:
             arrs = arrs + [arrs[-1]] * (target - n)
             plans = plans + [plans[-1]] * (target - n)
         try:
-            outs = chain_mod.run_batch(arrs, plans, sharding=self._sharding)
+            y = chain_mod.launch_batch(arrs, plans, sharding=self._sharding)
         except Exception as e:
             for it in items:
                 it.future.set_exception(e)
@@ -164,8 +193,23 @@ class Executor:
         self.stats.items += n
         self.stats.batches += 1
         self.stats.max_batch_seen = max(self.stats.max_batch_seen, n)
-        for it, out in zip(items, outs):
-            it.future.set_result(out)
+        # blocks when max_inflight batches are queued: natural backpressure
+        self._fetch_queue.put((y, arrs, plans, items))
+
+    def _fetch_loop(self):
+        while True:
+            got = self._fetch_queue.get()
+            if got is None:
+                break
+            y, arrs, plans, items = got
+            try:
+                outs = chain_mod.fetch_batch(y, arrs, plans)
+            except Exception as e:
+                for it in items:
+                    it.future.set_exception(e)
+                continue
+            for it, out in zip(items, outs):
+                it.future.set_result(out)
 
 
 _DEFAULT: Optional[Executor] = None

@@ -92,7 +92,7 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 bool jpeg_decode(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
-                 int* w, int* h, int* c, std::string* err) {
+                 int* w, int* h, int* c, std::string* err, int scale_denom) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -106,6 +106,12 @@ bool jpeg_decode(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
   jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = JCS_RGB;
+  if (scale_denom == 2 || scale_denom == 4 || scale_denom == 8) {
+    // shrink-on-load: decode at 1/N directly off the DCT (libvips does the
+    // same before its resample stage) — 1/N^2 the pixels to move and resample
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = (unsigned int)scale_denom;
+  }
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
@@ -279,7 +285,8 @@ bool webp_encode_buf(const uint8_t* pix, int w, int h, int c, int quality,
 PyObject* py_decode(PyObject*, PyObject* args) {
   Py_buffer view;
   const char* fmt;
-  if (!PyArg_ParseTuple(args, "y*s", &view, &fmt)) return nullptr;
+  int scale_denom = 1;
+  if (!PyArg_ParseTuple(args, "y*s|i", &view, &fmt, &scale_denom)) return nullptr;
   const uint8_t* buf = static_cast<const uint8_t*>(view.buf);
   size_t len = view.len;
   std::vector<uint8_t> out;
@@ -289,7 +296,7 @@ PyObject* py_decode(PyObject*, PyObject* args) {
   std::string f(fmt);
   Py_BEGIN_ALLOW_THREADS
   if (f == "jpeg") {
-    ok = jpeg_decode(buf, len, &out, &w, &h, &c, &err);
+    ok = jpeg_decode(buf, len, &out, &w, &h, &c, &err, scale_denom);
     if (ok) orientation = exif_orientation(buf, len);
   } else if (f == "png") {
     ok = png_decode_buf(buf, len, &out, &w, &h, &c, &err);
@@ -403,7 +410,7 @@ PyObject* py_probe(PyObject*, PyObject* args) {
 
 PyMethodDef methods[] = {
     {"decode", py_decode, METH_VARARGS,
-     "decode(bytes, fmt) -> (pixels, h, w, c, orientation, has_alpha)"},
+     "decode(bytes, fmt[, scale_denom]) -> (pixels, h, w, c, orientation, has_alpha)"},
     {"encode", py_encode, METH_VARARGS,
      "encode(buf, h, w, c, fmt, quality, compression, progressive) -> bytes"},
     {"probe", py_probe, METH_VARARGS,

@@ -32,3 +32,23 @@ def bucket_dim(n: int) -> int:
 
 def bucket_shape(h: int, w: int) -> tuple:
     return bucket_dim(h), bucket_dim(w)
+
+
+def tight_dim(n: int) -> int:
+    """Snug bucket for *output* dims: device->host readback over the
+    interconnect is the scarce resource (~fixed-cost + low bandwidth, see
+    engine/executor.py), so final-stage buckets round up much tighter than
+    the geometric input ladder — mult-of-16 under 512, coarser above, ladder
+    beyond 2048 (which also bounds the number of distinct compiled programs).
+    """
+    if n <= 0:
+        return 8
+    if n <= 512:
+        t = (n + 15) // 16 * 16
+    elif n <= 1024:
+        t = (n + 31) // 32 * 32
+    elif n <= 2048:
+        t = (n + 63) // 64 * 64
+    else:
+        t = bucket_dim(n)
+    return min(t, bucket_dim(n))  # never exceed the ladder rung (8..24 rungs)

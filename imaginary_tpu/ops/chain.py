@@ -78,18 +78,22 @@ def _stack_dyns(plans: list) -> tuple:
     return tuple(out)
 
 
-def run_batch(arrs: list, plans: list, sharding=None) -> list:
-    """Execute a batch of same-signature plans in one device call.
+def launch_batch(arrs: list, plans: list, sharding=None):
+    """Stage + dispatch one batched device call WITHOUT waiting for it.
 
     arrs: list of HWC uint8 arrays, all with the same bucket shape and C.
     plans: matching ImagePlans with identical spec_key().
     sharding: optional NamedSharding over the leading batch dim — inputs are
     placed with it and the jitted program partitions over the mesh.
-    Returns the list of HWC uint8 outputs (cropped to each plan's out dims).
+    Returns the device output array (uint8, still computing), or None for an
+    identity chain. JAX dispatch is async, so host->device transfer and
+    compute proceed while the caller pipelines further batches; pair with
+    fetch_batch (ideally on a dedicated thread — device->host readback is
+    the link's scarce, serialize-me resource).
     """
     specs = plans[0].spec_key()
     if not specs:
-        return [np.asarray(a) for a in arrs]
+        return None
     batch = np.stack([pad_to_bucket(a) for a in arrs])
     h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
     w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
@@ -104,8 +108,20 @@ def run_batch(arrs: list, plans: list, sharding=None) -> list:
     )
     fn = _compiled(specs, batch.shape, dyn_key)
     y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
+    return y
+
+
+def fetch_batch(y, arrs: list, plans: list) -> list:
+    """Block on a launch_batch result and slice out per-image outputs."""
+    if y is None:
+        return [np.asarray(a) for a in arrs]
     y = np.asarray(jax.device_get(y))
     return [y[i, : p.out_h, : p.out_w] for i, p in enumerate(plans)]
+
+
+def run_batch(arrs: list, plans: list, sharding=None) -> list:
+    """Synchronous convenience: launch + fetch in one call."""
+    return fetch_batch(launch_batch(arrs, plans, sharding=sharding), arrs, plans)
 
 
 def run_single(arr: np.ndarray, plan: ImagePlan) -> np.ndarray:

@@ -22,7 +22,7 @@ import numpy as np
 from imaginary_tpu.errors import ImageError, new_error
 from imaginary_tpu.imgtype import ImageType, image_type
 from imaginary_tpu.options import Colorspace, Extend, Gravity, ImageOptions, apply_aspect_ratio
-from imaginary_tpu.ops.buckets import MAX_DIM, bucket_dim
+from imaginary_tpu.ops.buckets import MAX_DIM, bucket_dim, bucket_shape, tight_dim
 from imaginary_tpu.ops.stages import (
     BlurSpec,
     CompositeSpec,
@@ -32,6 +32,7 @@ from imaginary_tpu.ops.stages import (
     FlopSpec,
     GraySpec,
     SampleSpec,
+    ShrinkBucketSpec,
     SmartExtractSpec,
     TransposeSpec,
 )
@@ -503,4 +504,134 @@ def plan_operation(name: str, o: ImageOptions, src_h: int, src_w: int,
     else:
         _PLANNERS[name](p, o, channels)
     _common_postlude(p, o, channels)
+    _tighten_output_bucket(p, src_h, src_w)
     return ImagePlan(stages=p.stages, out_h=p.h, out_w=p.w)
+
+
+_SHRINK_SAFE_OPS = frozenset({"resize", "fit", "thumbnail", "crop", "smartcrop"})
+
+
+def choose_decode_shrink(name: str, o: ImageOptions, src_h: int, src_w: int,
+                         orientation: int, channels: int) -> int:
+    """Largest JPEG shrink-on-load denominator in {8,4,2} that provably
+    preserves the operation's output, else 1.
+
+    The gate is by *construction*, not heuristics: re-plan the operation on
+    the shrunk source dims (ceil(dim/N), libjpeg's scaled-decode size) and
+    accept N only when (a) the plan produces identical output dims, and
+    (b) its first resample is still a pure downscale — i.e. the chain never
+    has to invent detail the scaled decode threw away. Ops that address
+    source pixels by absolute coordinates (extract/zoom/watermark placement)
+    are excluded up front. This mirrors libvips' shrink-on-load, the single
+    biggest decode-side win on large JPEGs (SURVEY.md section 3.2 hot loop).
+    """
+    if name not in _SHRINK_SAFE_OPS or src_h <= 0 or src_w <= 0:
+        return 1
+    try:
+        full = plan_operation(name, o, src_h, src_w, orientation, channels)
+    except ImageError:
+        return 1
+    if not full.stages:
+        return 1
+    for denom in (8, 4, 2):
+        sh = -(-src_h // denom)
+        sw = -(-src_w // denom)
+        if sh < 8 or sw < 8:
+            continue
+        try:
+            p = plan_operation(name, o, sh, sw, orientation, channels)
+        except ImageError:
+            continue
+        if (p.out_h, p.out_w) != (full.out_h, full.out_w):
+            continue
+        if not _plans_equivalent(full, p):
+            # e.g. an enlarge-clamp kicked in on the shrunk dims and the
+            # plan degenerated (same output dims, different content)
+            continue
+        if _chain_upscales(p, sh, sw):
+            continue
+        return denom
+    return 1
+
+
+def _plans_equivalent(a: ImagePlan, b: ImagePlan) -> bool:
+    """Stage-for-stage identical: same specs AND same dynamic params.
+
+    Every dyn value (resample targets, crop windows, canvas offsets, fills)
+    lives in *output* space, so a source-resolution change that is truly
+    transparent leaves all of them untouched; any difference means the
+    operation actually depends on source resolution and must not shrink.
+    The specs themselves may differ only in bucket dims (tight_dim of equal
+    valid dims is equal, so they won't)."""
+    if len(a.stages) != len(b.stages):
+        return False
+    for sa, sb in zip(a.stages, b.stages):
+        if sa.spec != sb.spec:
+            return False
+        if sa.dyn.keys() != sb.dyn.keys():
+            return False
+        for k in sa.dyn:
+            if not np.array_equal(sa.dyn[k], sb.dyn[k]):
+                return False
+    return True
+
+
+def _chain_upscales(plan: ImagePlan, src_h: int, src_w: int) -> bool:
+    """True if any resample stage enlarges relative to its input dims."""
+    cur_h, cur_w = src_h, src_w
+    for st in plan.stages:
+        spec = st.spec
+        if isinstance(spec, TransposeSpec):
+            cur_h, cur_w = cur_w, cur_h
+        elif isinstance(spec, SampleSpec):
+            dh, dw = int(st.dyn["dst_h"]), int(st.dyn["dst_w"])
+            if dh > cur_h or dw > cur_w:
+                return True
+            cur_h, cur_w = dh, dw
+        elif isinstance(spec, (ExtractSpec, SmartExtractSpec)):
+            cur_h, cur_w = int(st.dyn["new_h"]), int(st.dyn["new_w"])
+        elif isinstance(spec, EmbedSpec):
+            cur_h, cur_w = int(st.dyn["canvas_h"]), int(st.dyn["canvas_w"])
+    return False
+
+
+def _final_bucket(stages: list, src_h: int, src_w: int) -> tuple:
+    """Track the padded-buffer dims through the chain (host-side mirror of
+    what the device program will produce)."""
+    hb, wb = bucket_shape(src_h, src_w)
+    for st in stages:
+        spec = st.spec
+        if isinstance(spec, TransposeSpec):
+            hb, wb = wb, hb
+        elif hasattr(spec, "out_hb"):
+            hb, wb = spec.out_hb, spec.out_wb
+    return hb, wb
+
+
+def _tighten_output_bucket(p: _Planner, src_h: int, src_w: int) -> None:
+    """Shrink the chain's FINAL bucket to a snug multiple-of-16 one.
+
+    Device->host readback has a large fixed cost and low bandwidth on the
+    host<->TPU link (the opposite of host->device, which is cheap), so the
+    bytes the final stage emits dominate end-to-end throughput. Walk back
+    past bucket-preserving stages and retarget the last shape-bearing spec;
+    if the chain has none (flip/rotate-only chains), append a static slice.
+    """
+    th, tw = tight_dim(p.h), tight_dim(p.w)
+    hb, wb = _final_bucket(p.stages, src_h, src_w)
+    if (th, tw) == (hb, wb):
+        return
+    want_h, want_w = th, tw
+    for st in reversed(p.stages):
+        spec = st.spec
+        if isinstance(spec, TransposeSpec):
+            want_h, want_w = want_w, want_h
+            continue
+        if isinstance(spec, (SampleSpec, ExtractSpec, EmbedSpec, SmartExtractSpec)):
+            if (spec.out_hb, spec.out_wb) != (want_h, want_w):
+                st.spec = dataclasses.replace(spec, out_hb=want_h, out_wb=want_w)
+            return
+        if isinstance(spec, (FlipSpec, FlopSpec, BlurSpec, GraySpec, CompositeSpec, ShrinkBucketSpec)):
+            continue
+        break  # unknown spec: don't reason past it
+    p.add(ShrinkBucketSpec(th, tw))

@@ -319,6 +319,21 @@ class CompositeSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShrinkBucketSpec:
+    """Static slice of the padded buffer down to a snugger bucket (valid
+    dims unchanged). Appended when a chain's final bucket is far larger than
+    its valid output needs, so the device->host readback — the scarce
+    resource on the host<->TPU link — moves tight buffers, not ladder pads.
+    """
+
+    out_hb: int
+    out_wb: int
+
+    def apply(self, x, h, w, dyn):
+        return x[:, : self.out_hb, : self.out_wb, :], h, w
+
+
+@dataclasses.dataclass(frozen=True)
 class GraySpec:
     """Rec.709 luma, broadcast back over RGB (colorspace=bw,
     ref: params.go:392-397)."""

@@ -27,7 +27,12 @@ from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
 from imaginary_tpu.options import ImageOptions
 from imaginary_tpu.params import build_params_from_operation
 from imaginary_tpu.ops import chain as chain_mod
-from imaginary_tpu.ops.plan import OPERATION_NAMES, ImagePlan, plan_operation
+from imaginary_tpu.ops.plan import (
+    OPERATION_NAMES,
+    ImagePlan,
+    choose_decode_shrink,
+    plan_operation,
+)
 
 # Ops servable over HTTP (ref: OperationsMap image.go:15-32 + /info + /pipeline)
 ALL_OPERATIONS = OPERATION_NAMES + ("info", "pipeline")
@@ -122,7 +127,7 @@ def process_operation(
     if name not in OPERATION_NAMES:
         raise new_error(f"Unsupported operation: {name}", 400)
 
-    d = codecs.decode(buf)
+    d = codecs.decode(buf, _pick_shrink(name, buf, o))
     wm = _fetch_watermark(name, o, watermark_fetcher)
     plan = plan_operation(
         name, o, d.array.shape[0], d.array.shape[1], d.orientation,
@@ -130,6 +135,25 @@ def process_operation(
     )
     arr = _run_stages(d.array, plan, runner)
     return _encode(arr, o, _encode_type(o, d.type))
+
+
+def _pick_shrink(name: str, buf: bytes, o: ImageOptions) -> int:
+    """JPEG shrink-on-load denominator for this request (1 = full decode).
+
+    A header-only probe supplies source dims/orientation; the planner then
+    proves (by re-planning) that decoding at 1/N preserves the output. Pays
+    one extra header parse (~0.1 ms) to avoid decoding/moving up to 64x the
+    pixels the chain will immediately throw away."""
+    from imaginary_tpu.imgtype import determine_image_type
+
+    if determine_image_type(buf) is not ImageType.JPEG:
+        return 1
+    try:
+        meta = codecs.probe(buf)
+        return choose_decode_shrink(name, o, meta.height, meta.width,
+                                    meta.orientation, max(3, meta.channels))
+    except ImageError:
+        return 1
 
 
 def process_pipeline(

@@ -65,7 +65,7 @@ class TestExecutor:
 
         ex = Executor(ExecutorConfig(window_ms=1))
         plan = _resize_plan(100, 80, 40)
-        real = executor_mod.chain_mod.run_batch
+        real = executor_mod.chain_mod.launch_batch
         calls = {"n": 0}
 
         def flaky(*a, **k):
@@ -74,7 +74,7 @@ class TestExecutor:
                 raise RuntimeError("device fell over")
             return real(*a, **k)
 
-        monkeypatch.setattr(executor_mod.chain_mod, "run_batch", flaky)
+        monkeypatch.setattr(executor_mod.chain_mod, "launch_batch", flaky)
         with pytest.raises(RuntimeError, match="device fell over"):
             ex.process(_img(100, 80), plan)
         # executor survives and keeps serving

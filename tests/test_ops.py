@@ -351,3 +351,40 @@ class TestBucketClampRegressions:
         o = build_params_from_operation(PipelineOperation(name="zoom", params={"factor": -2}))
         with pytest.raises(ImageError):
             process_operation("zoom", buf, o)
+
+
+class TestOutputBucketTightening:
+    """Final-stage buckets round to snug mult-of-16 dims: device->host
+    readback bytes, not the geometric input ladder, bound throughput."""
+
+    def test_tight_dim_ladder(self):
+        from imaginary_tpu.ops.buckets import bucket_dim, tight_dim
+
+        assert tight_dim(200) == 208
+        assert tight_dim(300) == 304
+        assert tight_dim(512) == 512
+        assert tight_dim(513) == 544
+        assert tight_dim(2000) == 2048
+        for n in (1, 17, 99, 511, 1025, 4000):
+            assert n <= tight_dim(n) <= bucket_dim(n)
+
+    def test_final_sample_stage_retargeted(self):
+        from imaginary_tpu.ops.plan import plan_operation
+        from imaginary_tpu.ops.stages import SampleSpec
+
+        plan = plan_operation("resize", ImageOptions(width=300, height=200), 1080, 1920, 0, 3)
+        last_shape = [s.spec for s in plan.stages if hasattr(s.spec, "out_hb")][-1]
+        assert (last_shape.out_hb, last_shape.out_wb) == (208, 304)
+
+    def test_shape_preserving_chain_gets_slice_stage(self):
+        from imaginary_tpu.ops.plan import plan_operation
+        from imaginary_tpu.ops.stages import ShrinkBucketSpec
+
+        # flip keeps 1080p dims: ladder pad (1280, 2048) -> tight (1088, 1920)
+        plan = plan_operation("flip", ImageOptions(), 1080, 1920, 0, 3)
+        assert isinstance(plan.stages[-1].spec, ShrinkBucketSpec)
+        assert (plan.stages[-1].spec.out_hb, plan.stages[-1].spec.out_wb) == (1088, 1920)
+
+    def test_tightened_chain_still_correct(self, jpg):
+        out = process_operation("resize", jpg, ImageOptions(width=300, height=200))
+        assert oracle(out.body)[:2] == (300, 200)

@@ -1,0 +1,116 @@
+"""Shrink-on-load: scaled JPEG decode + the planner's output-preserving gate.
+
+The reference gets this for free from libvips' shrink-on-load inside
+bimg.Resize (SURVEY.md section 3.2 hot loop); here the planner must *prove*
+a denominator is transparent (identical plan stage-for-stage) before the
+codec decodes at 1/N.
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu import codecs
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops.plan import choose_decode_shrink, plan_operation
+from imaginary_tpu.pipeline import process_operation
+from tests.conftest import fixture_bytes
+
+
+def _jpeg(w, h):
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    im = Image.fromarray(arr)
+    out = io.BytesIO()
+    im.save(out, "JPEG", quality=90)
+    return out.getvalue()
+
+
+class TestScaledDecode:
+    @pytest.mark.parametrize("shrink", [2, 4, 8])
+    def test_jpeg_dims_are_ceil_div(self, shrink):
+        buf = _jpeg(1000, 600)
+        d = codecs.decode(buf, shrink)
+        assert d.array.shape[0] == -(-600 // shrink)
+        assert d.array.shape[1] == -(-1000 // shrink)
+
+    def test_shrink_one_is_full(self):
+        buf = _jpeg(320, 200)
+        assert codecs.decode(buf, 1).array.shape == (200, 320, 3)
+
+    def test_non_jpeg_ignores_shrink(self, testdata):
+        buf = fixture_bytes("test.png")
+        full = codecs.decode(buf).array.shape
+        assert codecs.decode(buf, 4).array.shape == full
+
+    def test_orientation_survives_scaled_decode(self, testdata):
+        buf = fixture_bytes("exif-orient-6.jpg")
+        assert codecs.decode(buf, 2).orientation == 6
+
+
+class TestChooseShrink:
+    def test_big_downscale_picks_large_denom(self):
+        o = ImageOptions(width=300)
+        assert choose_decode_shrink("resize", o, 1080, 1920, 0, 3) in (2, 4)
+
+    def test_small_downscale_declines(self):
+        o = ImageOptions(width=1800)
+        assert choose_decode_shrink("resize", o, 1080, 1920, 0, 3) == 1
+
+    def test_upscale_declines(self):
+        o = ImageOptions(width=3000)
+        assert choose_decode_shrink("resize", o, 1080, 1920, 0, 3) == 1
+
+    def test_absolute_coordinate_ops_decline(self):
+        o = ImageOptions(area_width=100, area_height=100, top=10, left=10)
+        assert choose_decode_shrink("extract", o, 1080, 1920, 0, 3) == 1
+        z = ImageOptions(factor=2)
+        assert choose_decode_shrink("zoom", z, 1080, 1920, 0, 3) == 1
+
+    def test_degenerate_equal_dims_plan_rejected(self):
+        # resize 300x200 of 1080p goes through the embed path; at 1/8 the
+        # enlarge-clamp degenerates the plan (same out dims, different
+        # content) — the stage-equality gate must refuse that denominator
+        # while a transparent one (1/4: 270x480 still downscales) passes
+        o = ImageOptions(width=300, height=200)
+        d = choose_decode_shrink("resize", o, 1080, 1920, 0, 3)
+        assert d == 4
+
+    def test_plan_on_shrunk_dims_matches_full_plan(self):
+        o = ImageOptions(width=300)
+        denom = choose_decode_shrink("resize", o, 1080, 1920, 0, 3)
+        assert denom > 1
+        full = plan_operation("resize", o, 1080, 1920, 0, 3)
+        shrunk = plan_operation("resize", o, -(-1080 // denom), -(-1920 // denom), 0, 3)
+        assert (shrunk.out_h, shrunk.out_w) == (full.out_h, full.out_w)
+        assert [type(s.spec) for s in shrunk.stages] == [type(s.spec) for s in full.stages]
+
+
+class TestEndToEnd:
+    def test_resize_output_dims_identical_with_and_without_shrink(self):
+        buf = _jpeg(1600, 1200)
+        o = ImageOptions(width=150)
+        out = process_operation("resize", buf, o)
+        im = Image.open(io.BytesIO(out.body))
+        # full-decode ground truth: 1200 * 150/1600 = 112.5 -> 113
+        assert (im.width, im.height) == (150, 113)
+
+    def test_thumbnail_content_close_to_full_decode_path(self):
+        # same request forced through full decode vs shrink-on-load: the
+        # resampled outputs must agree closely (libvips parity bar)
+        buf = _jpeg(1024, 768)
+        o = ImageOptions(width=128)
+        d_full = codecs.decode(buf, 1)
+        d_shr = codecs.decode(buf, choose_decode_shrink("thumbnail", o, 768, 1024, 0, 3))
+        from imaginary_tpu.ops.chain import run_single
+
+        p_full = plan_operation("thumbnail", o, *d_full.array.shape[:2], 0, 3)
+        p_shr = plan_operation("thumbnail", o, *d_shr.array.shape[:2], 0, 3)
+        a = run_single(d_full.array, p_full).astype(np.float32)
+        b = run_single(d_shr.array, p_shr).astype(np.float32)
+        assert a.shape == b.shape
+        # random-noise source is the worst case for DCT-scaled decode;
+        # mean abs difference stays bounded
+        assert float(np.mean(np.abs(a - b))) < 16.0
