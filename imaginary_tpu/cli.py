@@ -142,8 +142,10 @@ def main(argv=None) -> int:
     o = options_from_args(args)
 
     # Pin the JAX platform when asked (e.g. IMAGINARY_TPU_PLATFORM=cpu for
-    # dev boxes where the TPU plugin force-registers itself at boot).
-    platform = os.environ.get("IMAGINARY_TPU_PLATFORM", "")
+    # dev boxes where the TPU plugin force-registers itself at boot and
+    # overrides the standard JAX_PLATFORMS env var — re-pin it explicitly
+    # via jax.config so the override wins).
+    platform = os.environ.get("IMAGINARY_TPU_PLATFORM", "") or os.environ.get("JAX_PLATFORMS", "")
     if platform:
         import jax
 

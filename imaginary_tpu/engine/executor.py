@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from imaginary_tpu.engine import host_exec
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
 from imaginary_tpu.ops.plan import ImagePlan
@@ -31,30 +32,53 @@ from imaginary_tpu.ops.plan import ImagePlan
 @dataclasses.dataclass
 class ExecutorConfig:
     window_ms: float = 3.0
-    max_batch: int = 16
-    max_inflight: int = 4  # batches launched but not yet fetched
+    max_batch: int = 16  # device-call chunk size (the jit batch-shape ladder tops out here)
+    max_group: int = 64  # accumulation cap: one fetch drains up to this many images
+    max_hold_ms: float = 250.0  # hard age cap: dispatch a group this old even if the link is busy
+    max_inflight: int = 4  # groups launched but not yet fetched
     use_mesh: bool = False  # shard micro-batches over the device mesh
     n_devices: Optional[int] = None  # None = all devices
     spatial: int = 1  # spatial mesh axis size (sp sharding for huge images)
+    # Cost-model placement: the device path is primary, but placement is
+    # decided per item from MEASURED costs. The fetcher maintains an EWMA of
+    # device per-item drain time (the D2H readback is the scarce resource);
+    # spilled runs maintain an EWMA of host execution time. An item spills to
+    # the host SIMD backend (host_exec.py) when its estimated device wait —
+    # (owed_items + 1) x device_item_ms — exceeds spill_factor x host_item_ms.
+    # On a fast PCIe/ICI link device_item_ms is microseconds and everything
+    # rides the device; on a slow tunneled link the device absorbs exactly
+    # its drain rate and the host soaks up the rest. Every probe_interval-th
+    # spill-eligible item rides the device anyway to refresh the estimate.
+    host_spill: bool = True
+    spill_factor: float = 6.0
+    probe_interval: int = 256
 
 
 @dataclasses.dataclass
 class ExecutorStats:
     items: int = 0
-    batches: int = 0
-    max_batch_seen: int = 0
+    batches: int = 0  # device calls (chunks of <= max_batch)
+    groups: int = 0  # drains (each = one parallel device_get over its chunks)
+    max_group_seen: int = 0
     queue_depth: int = 0
     compile_cache_size: int = 0
+    spilled: int = 0
+    device_item_ms: float = 0.0  # measured per-item drain cost (cost model)
+    host_item_ms: float = 0.0  # measured host-spill execution cost
 
     def to_dict(self) -> dict:
-        avg = self.items / self.batches if self.batches else 0.0
         return {
             "items": self.items,
             "batches": self.batches,
-            "avg_batch": round(avg, 3),
-            "max_batch": self.max_batch_seen,
+            "groups": self.groups,
+            "avg_batch": round(self.items / self.batches, 3) if self.batches else 0.0,
+            "avg_group": round(self.items / self.groups, 3) if self.groups else 0.0,
+            "max_group": self.max_group_seen,
             "queue_depth": self.queue_depth,
             "compile_cache_size": chain_mod.cache_size(),
+            "spilled": self.spilled,
+            "device_item_ms": round(self.device_item_ms, 3),
+            "host_item_ms": round(self.host_item_ms, 3),
         }
 
 
@@ -86,13 +110,21 @@ class Executor:
             self._sharding = batch_sharding(mesh)
             self._mesh_batch = mesh.devices.shape[0]
         self._running = True
-        # Launched-but-unfetched batches ride this bounded queue: the
+        # Launched-but-unfetched groups ride this bounded queue: the
         # collector keeps dispatching (H2D + compute are cheap and async)
-        # while ONE fetch thread serially drains device->host readbacks —
-        # the link's readback path has a large fixed cost, low bandwidth,
-        # and degrades badly under concurrent fetches, so overlap comes
-        # from pipelining compute behind a single ordered D2H stream.
+        # while ONE fetch thread drains device->host readbacks. The link's
+        # D2H path is the scarce resource (~60 ms fixed cost + low
+        # bandwidth, measured), so the policy everywhere is: move MANY
+        # images per drain. A group is several chunk-sized device calls
+        # fetched together with one parallel device_get.
         self._fetch_queue: queue_mod.Queue = queue_mod.Queue(maxsize=self.config.max_inflight)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._device_owed = 0
+        self._owed_lock = threading.Lock()
+        self._device_item_ms: Optional[float] = None  # EWMA, fetcher-updated
+        self._host_item_ms: float = 2.0  # EWMA, bootstrap estimate
+        self._spill_seen = 0
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
         self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher", daemon=True)
@@ -101,13 +133,54 @@ class Executor:
     # -- public API ------------------------------------------------------------
 
     def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
-        """Enqueue one image; resolves to the output HWC uint8 array."""
+        """Enqueue one image; resolves to the output HWC uint8 array.
+
+        Placement: identity chains resolve immediately; otherwise the
+        cost model in _should_spill decides — when the item's estimated
+        device wait exceeds spill_factor x the measured host cost and the
+        plan is host-executable, it runs inline on the caller's thread
+        instead of queueing behind a drain the link can't keep up with.
+        """
         item = _Item(arr, plan)
         if not plan.stages:  # identity chain: no device work at all
             item.future.set_result(arr)
             return item.future
+        if self.config.host_spill and self._should_spill(plan):
+            t0 = time.monotonic()
+            try:
+                item.future.set_result(host_exec.run(arr, plan))
+            except Exception as e:
+                item.future.set_exception(e)
+            else:
+                ms = (time.monotonic() - t0) * 1000.0
+                with self._owed_lock:
+                    self._host_item_ms = 0.8 * self._host_item_ms + 0.2 * ms
+                    self.stats.host_item_ms = self._host_item_ms
+            self.stats.spilled += 1
+            return item.future
+        with self._owed_lock:
+            self._device_owed += 1
+        item.future.add_done_callback(self._on_done)
         self._queue.put(item)
         return item.future
+
+    def _on_done(self, _fut) -> None:
+        with self._owed_lock:
+            self._device_owed -= 1
+
+    def _should_spill(self, plan: ImagePlan) -> bool:
+        dev_ms = self._device_item_ms
+        if dev_ms is None:  # device cost unknown: it is the primary path
+            return False
+        wait_ms = (self._device_owed + 1) * dev_ms
+        if wait_ms <= self.config.spill_factor * self._host_item_ms:
+            return False
+        if not host_exec.can_execute(plan):
+            return False
+        with self._owed_lock:
+            self._spill_seen += 1
+            probe = self._spill_seen % self.config.probe_interval == 0
+        return not probe  # periodic probe keeps device_item_ms fresh
 
     def process(self, arr: np.ndarray, plan: ImagePlan, timeout: float = 120.0) -> np.ndarray:
         """Blocking convenience wrapper."""
@@ -125,13 +198,31 @@ class Executor:
     # -- collector -------------------------------------------------------------
 
     def _collector(self):
+        """Batch formation policy (SURVEY.md section 7 hard-part #2).
+
+        A group dispatches when ANY of:
+          - it reached max_group (one full drain's worth), or
+          - its oldest item expired the window AND the D2H link is idle
+            (inflight == 0) — under light load this bounds added latency,
+            while under load it keeps accumulating instead of wasting a
+            fixed-cost readback on a near-empty batch, or
+          - its oldest item is older than max_hold_ms (starvation guard for
+            a trickling chain key while another key saturates the link).
+        """
         window = self.config.window_ms / 1000.0
+        hold = self.config.max_hold_ms / 1000.0
         pending: dict = {}  # key -> list[_Item]
         while self._running:
             timeout = None
             if pending:
                 oldest = min(items[0].t for items in pending.values())
-                timeout = max(0.0, oldest + window - time.monotonic())
+                now = time.monotonic()
+                if now - oldest >= window:
+                    # window already expired but the link may be busy: poll
+                    # briefly, re-checking inflight and the hold cap
+                    timeout = 0.002
+                else:
+                    timeout = oldest + window - now
             try:
                 got = self._queue.get(timeout=timeout)
                 if got is None:
@@ -154,21 +245,27 @@ class Executor:
                         break
                     pending.setdefault(more.key, []).append(more)
             now = time.monotonic()
+            with self._inflight_lock:
+                link_idle = self._inflight == 0
             due = [
                 k for k, items in pending.items()
-                if len(items) >= self.config.max_batch or now - items[0].t >= window
+                if len(items) >= self.config.max_group
+                or (now - items[0].t >= window and link_idle)
+                or now - items[0].t >= hold
             ]
             for k in due:
                 items = pending.pop(k)
-                for start in range(0, len(items), self.config.max_batch):
-                    self._dispatch(items[start : start + self.config.max_batch])
+                for start in range(0, len(items), self.config.max_group):
+                    self._dispatch(items[start : start + self.config.max_group])
             self.stats.queue_depth = self._queue.qsize() + sum(len(v) for v in pending.values())
         # drain on shutdown, then release the fetcher
         for items in pending.values():
             self._dispatch(items)
         self._fetch_queue.put(None)
 
-    def _dispatch(self, items: list):
+    def _launch_chunk(self, items: list):
+        """Launch one device call of <= max_batch items; returns
+        (device_out, padded_arrs, padded_plans) or raises."""
         n = len(items)
         arrs = [it.arr for it in items]
         plans = [it.plan for it in items]
@@ -184,32 +281,75 @@ class Executor:
         if target > n:
             arrs = arrs + [arrs[-1]] * (target - n)
             plans = plans + [plans[-1]] * (target - n)
+        y = chain_mod.launch_batch(arrs, plans, sharding=self._sharding)
+        return y, arrs, plans
+
+    def _dispatch(self, items: list):
+        """Launch a group as chunk-sized device calls; enqueue ONE fetch
+        task covering all of them, so the fetcher drains the whole group
+        with a single parallel device_get (measured ~1.4x the bandwidth of
+        a serial per-buffer fetch, and the per-drain fixed cost amortizes
+        over the group, not the chunk)."""
+        chunks = []
         try:
-            y = chain_mod.launch_batch(arrs, plans, sharding=self._sharding)
+            for start in range(0, len(items), self.config.max_batch):
+                sub = items[start : start + self.config.max_batch]
+                y, arrs, plans = self._launch_chunk(sub)
+                chunks.append((y, arrs, plans, sub))
         except Exception as e:
             for it in items:
                 it.future.set_exception(e)
             return
-        self.stats.items += n
-        self.stats.batches += 1
-        self.stats.max_batch_seen = max(self.stats.max_batch_seen, n)
-        # blocks when max_inflight batches are queued: natural backpressure
-        self._fetch_queue.put((y, arrs, plans, items))
+        self.stats.items += len(items)
+        self.stats.groups += 1
+        self.stats.batches += len(chunks)
+        self.stats.max_group_seen = max(self.stats.max_group_seen, len(items))
+        with self._inflight_lock:
+            self._inflight += 1
+        # blocks when max_inflight groups are queued: natural backpressure
+        self._fetch_queue.put(chunks)
 
     def _fetch_loop(self):
         while True:
             got = self._fetch_queue.get()
             if got is None:
                 break
-            y, arrs, plans, items = got
+            chunks = got
+            t0 = time.monotonic()
             try:
-                outs = chain_mod.fetch_batch(y, arrs, plans)
+                fetched = chain_mod.fetch_groups([c[0] for c in chunks])
             except Exception as e:
-                for it in items:
-                    it.future.set_exception(e)
+                for _, _, _, sub in chunks:
+                    for it in sub:
+                        it.future.set_exception(e)
+                with self._inflight_lock:
+                    self._inflight -= 1
                 continue
-            for it, out in zip(items, outs):
-                it.future.set_result(out)
+            # Normalize the drain cost to half-group amortization: the D2H
+            # link has a large fixed cost, so a singleton probe drain must
+            # not be booked at its raw per-item price — that would lock the
+            # policy into permanent spill (the probe itself always rides in
+            # a near-empty group). Booking small drains optimistically means
+            # light-load traffic keeps riding the device; under real load
+            # groups are full and the estimate converges to the true
+            # amortized cost.
+            n_items = sum(len(c[3]) for c in chunks)
+            n_eff = max(n_items, self.config.max_group // 2)
+            ms = (time.monotonic() - t0) * 1000.0 / max(1, n_eff)
+            prev = self._device_item_ms
+            self._device_item_ms = ms if prev is None else 0.7 * prev + 0.3 * ms
+            self.stats.device_item_ms = self._device_item_ms
+            for host_y, (y, arrs, plans, sub) in zip(fetched, chunks):
+                try:
+                    outs = chain_mod.finish_batch(host_y, arrs, plans)
+                except Exception as e:
+                    for it in sub:
+                        it.future.set_exception(e)
+                    continue
+                for it, out in zip(sub, outs):
+                    it.future.set_result(out)
+            with self._inflight_lock:
+                self._inflight -= 1
 
 
 _DEFAULT: Optional[Executor] = None

@@ -1,0 +1,285 @@
+"""Host SIMD execution backend for ImagePlans.
+
+The executor's placement policy (executor.py) is cost-model driven: the
+device path is primary, but when the host<->device link is saturated —
+its D2H readback is the scarce resource, with a large fixed cost and low
+bandwidth on tunneled links — overflow work runs here, on the host's own
+SIMD pipeline (OpenCV when present, pure numpy otherwise). This mirrors the
+reference's placement reality in reverse: the reference is host-only
+(libvips worker threads, SURVEY.md section 2.12); we are device-first with
+the host as an adaptive spill valve, so a slow link degrades throughput
+gracefully instead of capping it.
+
+The interpreter executes the SAME ImagePlan stage chain the device would
+run (plan.py is the single source of geometry truth), on one image at a
+time with exact dims (no bucket padding). Resampling kernels are the
+host library's nearest equivalent, so outputs may differ from the device
+path at the level of resampling-filter choice (documented tolerance:
+dimensions exact, content within a few dB PSNR) — the same class of
+difference as libvips kernel selection vs other backends.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from imaginary_tpu.options import Extend
+from imaginary_tpu.ops.stages import (
+    BlurSpec,
+    CompositeSpec,
+    EmbedSpec,
+    ExtractSpec,
+    FlipSpec,
+    FlopSpec,
+    GraySpec,
+    SampleSpec,
+    ShrinkBucketSpec,
+    SmartExtractSpec,
+    TransposeSpec,
+)
+
+try:  # OpenCV releases the GIL inside its SIMD loops — ideal for the spill path
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover
+    cv2 = None
+    _HAS_CV2 = False
+
+
+_HOST_SPECS = (
+    SampleSpec,
+    ExtractSpec,
+    EmbedSpec,
+    FlipSpec,
+    FlopSpec,
+    TransposeSpec,
+    BlurSpec,
+    CompositeSpec,
+    ShrinkBucketSpec,
+    GraySpec,
+    SmartExtractSpec,
+)
+
+
+def can_execute(plan, for_spill: bool = True) -> bool:
+    """True when every stage of the plan has a host interpretation.
+
+    With for_spill (the executor's placement check), smartcrop chains are
+    excluded: the host and device saliency maps can legitimately pick
+    different windows, and a request's crop must not depend on link load.
+    """
+    for st in plan.stages:
+        if not isinstance(st.spec, _HOST_SPECS):
+            return False
+        if for_spill and isinstance(st.spec, SmartExtractSpec):
+            return False
+    return True
+
+
+def run(arr: np.ndarray, plan) -> np.ndarray:
+    """Execute a plan on one HWC uint8 image; returns HWC uint8."""
+    x = arr
+    for st in plan.stages:
+        x = _apply(st.spec, x, st.dyn)
+    if x.dtype != np.uint8:
+        x = np.clip(x + 0.5, 0.0, 255.0).astype(np.uint8)  # device rounding
+    return np.ascontiguousarray(x)
+
+
+# --- per-spec interpreters ----------------------------------------------------
+
+_CV2_KERNELS = {
+    "nearest": 0,  # cv2.INTER_NEAREST
+    "linear": 1,  # cv2.INTER_LINEAR
+    "cubic": 2,  # cv2.INTER_CUBIC
+    "lanczos2": 4,  # cv2.INTER_LANCZOS4 (closest available)
+    "lanczos3": 4,
+}
+
+
+def _apply(spec, x, dyn):
+    if isinstance(spec, SampleSpec):
+        dh, dw = int(dyn["dst_h"]), int(dyn["dst_w"])
+        if (dh, dw) == x.shape[:2]:
+            return x
+        if _HAS_CV2:
+            if spec.kernel == "nearest":
+                interp = cv2.INTER_NEAREST
+            elif dh < x.shape[0] and dw < x.shape[1]:
+                # minification: area averaging is the host analogue of the
+                # device's stretched-kernel (antialiased) resample
+                interp = cv2.INTER_AREA
+            else:
+                interp = _CV2_KERNELS.get(spec.kernel, cv2.INTER_LANCZOS4)
+            out = cv2.resize(x, (dw, dh), interpolation=interp)
+            if out.ndim == 2:  # cv2 drops a trailing singleton channel
+                out = out[:, :, None]
+            return out
+        return _np_resize(x, dh, dw, spec.kernel)
+
+    if isinstance(spec, ExtractSpec):
+        top, left = int(dyn["top"]), int(dyn["left"])
+        nh, nw = int(dyn["new_h"]), int(dyn["new_w"])
+        return x[top : top + nh, left : left + nw]
+
+    if isinstance(spec, EmbedSpec):
+        return _embed(spec, x, dyn)
+
+    if isinstance(spec, FlipSpec):
+        return x[::-1]
+
+    if isinstance(spec, FlopSpec):
+        return x[:, ::-1]
+
+    if isinstance(spec, TransposeSpec):
+        return np.transpose(x, (1, 0, 2))
+
+    if isinstance(spec, BlurSpec):
+        sigma = float(dyn["sigma"])
+        if sigma <= 0:
+            return x
+        k = 2 * spec.radius + 1
+        if _HAS_CV2:
+            out = cv2.GaussianBlur(x, (k, k), sigmaX=sigma, sigmaY=sigma,
+                                   borderType=cv2.BORDER_REPLICATE)
+            if out.ndim == 2:
+                out = out[:, :, None]
+            return out
+        return _np_blur(x, spec.radius, sigma)
+
+    if isinstance(spec, CompositeSpec):
+        return _composite(spec, x, dyn)
+
+    if isinstance(spec, ShrinkBucketSpec):
+        return x  # host buffers are never bucket-padded
+
+    if isinstance(spec, GraySpec):
+        f = x.astype(np.float32)
+        lum = 0.2126 * f[..., 0:1] + 0.7152 * f[..., 1:2] + 0.0722 * f[..., 2:3]
+        out = np.concatenate([lum, lum, lum], axis=-1)
+        if x.shape[2] == 4:
+            out = np.concatenate([out, f[..., 3:]], axis=-1)
+        return out
+
+    if isinstance(spec, SmartExtractSpec):
+        nh, nw = int(dyn["new_h"]), int(dyn["new_w"])
+        top, left = _smart_offsets_host(x, nh, nw)
+        return x[top : top + nh, left : left + nw]
+
+    raise NotImplementedError(f"no host interpreter for {type(spec).__name__}")
+
+
+def _embed(spec, x, dyn):
+    ch, cw = int(dyn["canvas_h"]), int(dyn["canvas_w"])
+    oy, ox = int(dyn["off_y"]), int(dyn["off_x"])
+    h, w = x.shape[:2]
+    pads = ((oy, max(0, ch - oy - h)), (ox, max(0, cw - ox - w)), (0, 0))
+    if spec.mode is Extend.MIRROR:
+        out = np.pad(x, pads, mode="symmetric")
+    elif spec.mode in (Extend.COPY, Extend.LAST):
+        out = np.pad(x, pads, mode="edge")
+    else:
+        fill = np.asarray(dyn["fill"], dtype=np.float32)
+        if spec.mode is Extend.WHITE:
+            pass  # fill already carries 255s from the planner
+        out = np.empty((h + pads[0][0] + pads[0][1], w + pads[1][0] + pads[1][1], x.shape[2]),
+                       dtype=np.float32)
+        out[:] = fill[None, None, : x.shape[2]]
+        out[oy : oy + h, ox : ox + w] = x
+    return out[:ch, :cw]
+
+
+def _composite(spec, x, dyn):
+    f = x.astype(np.float32)
+    h, w = f.shape[:2]
+    bh, bw = int(dyn["block_h"]), int(dyn["block_w"])
+    top, left = int(dyn["top"]), int(dyn["left"])
+    ovl = np.asarray(dyn["overlay"], dtype=np.float32)[:bh, :bw]
+    opacity = float(np.clip(dyn["opacity"], 0.0, 1.0))
+    canvas = np.zeros((h, w, 4), dtype=np.float32)
+    if spec.replicate:
+        py = np.remainder(np.arange(h) - top, max(bh, 1))
+        px = np.remainder(np.arange(w) - left, max(bw, 1))
+        canvas = ovl[py][:, px]
+    else:
+        y0, x0 = max(0, top), max(0, left)
+        y1, x1 = min(h, top + bh), min(w, left + bw)
+        if y1 > y0 and x1 > x0:
+            canvas[y0:y1, x0:x1] = ovl[y0 - top : y1 - top, x0 - left : x1 - left]
+    alpha = canvas[..., 3:4] / 255.0 * opacity
+    rgb = f[..., :3] * (1.0 - alpha) + canvas[..., :3] * alpha
+    if f.shape[2] == 4:
+        return np.concatenate([rgb, f[..., 3:]], axis=-1)
+    return rgb
+
+
+def _np_resize(x, dh, dw, kernel):
+    """Exact port of the device's sampling-matrix resample (numpy fallback)."""
+    f = x.astype(np.float32)
+    wy = _np_sample_matrix(dh, f.shape[0], kernel)
+    wx = _np_sample_matrix(dw, f.shape[1], kernel)
+    t = np.einsum("yk,kwc->ywc", wy, f)
+    return np.einsum("xw,ywc->yxc", wx, t)
+
+
+def _np_kernel(kind, d):
+    ad = np.abs(d)
+    if kind in ("lanczos3", "lanczos2"):
+        a = 3.0 if kind == "lanczos3" else 2.0
+        return np.where(ad < a, np.sinc(d) * np.sinc(d / a), 0.0)
+    if kind == "cubic":
+        a = -0.5
+        w1 = (a + 2) * ad**3 - (a + 3) * ad**2 + 1
+        w2 = a * ad**3 - 5 * a * ad**2 + 8 * a * ad - 4 * a
+        return np.where(ad <= 1, w1, np.where(ad < 2, w2, 0.0))
+    if kind == "linear":
+        return np.maximum(0.0, 1.0 - ad)
+    return np.where((d >= -0.5) & (d < 0.5), 1.0, 0.0)  # nearest
+
+
+def _np_sample_matrix(out_n, in_n, kind):
+    y = np.arange(out_n, dtype=np.float32)[:, None]
+    k = np.arange(in_n, dtype=np.float32)[None, :]
+    scale = out_n / in_n
+    centre = (y + 0.5) / scale - 0.5
+    stretch = max(1.0, 1.0 / scale)
+    wts = _np_kernel(kind, (k - centre) / stretch)
+    norm = wts.sum(axis=-1, keepdims=True)
+    return np.where(norm > 1e-6, wts / np.maximum(norm, 1e-6), 0.0)
+
+
+def _np_blur(x, radius, sigma):
+    taps = np.arange(-radius, radius + 1, dtype=np.float32)
+    kern = np.exp(-0.5 * (taps / max(sigma, 1e-3)) ** 2)
+    kern /= kern.sum()
+    f = x.astype(np.float32)
+    pad = np.pad(f, ((radius, radius), (0, 0), (0, 0)), mode="edge")
+    f = sum(kern[i] * pad[i : i + f.shape[0]] for i in range(2 * radius + 1))
+    pad = np.pad(f, ((0, 0), (radius, radius), (0, 0)), mode="edge")
+    return sum(kern[i] * pad[:, i : i + f.shape[1]] for i in range(2 * radius + 1))
+
+
+def _smart_offsets_host(x, nh, nw):
+    """Host analogue of ops/saliency.smart_offsets: gradient-magnitude
+    saliency, integral image, best window by summed attention."""
+    f = x[..., :3].astype(np.float32).mean(axis=-1)
+    gy = np.abs(np.diff(f, axis=0, prepend=f[:1]))
+    gx = np.abs(np.diff(f, axis=1, prepend=f[:, :1]))
+    sal = gy + gx
+    ii = np.zeros((sal.shape[0] + 1, sal.shape[1] + 1), dtype=np.float64)
+    ii[1:, 1:] = sal.cumsum(0).cumsum(1)
+    h, w = sal.shape
+    nh, nw = min(nh, h), min(nw, w)
+    ys = np.arange(0, h - nh + 1)
+    xs = np.arange(0, w - nw + 1)
+    # coarse stride keeps this O(few hundred) windows like the device kernel
+    sy = max(1, len(ys) // 64)
+    sx = max(1, len(xs) // 64)
+    ys, xs = ys[::sy], xs[::sx]
+    sums = (ii[ys[:, None] + nh, xs[None, :] + nw] - ii[ys[:, None], xs[None, :] + nw]
+            - ii[ys[:, None] + nh, xs[None, :]] + ii[ys[:, None], xs[None, :]])
+    iy, ix = np.unravel_index(np.argmax(sums), sums.shape)
+    return int(ys[iy]), int(xs[ix])

@@ -111,12 +111,37 @@ def launch_batch(arrs: list, plans: list, sharding=None):
     return y
 
 
+def fetch_groups(ys: list) -> list:
+    """Drain several launch_batch outputs with ONE parallel device_get.
+
+    The link's D2H path has a large fixed cost and benefits from concurrent
+    per-buffer streams; device_get on the whole list overlaps them.
+    Entries may be None (identity chains) and pass through unchanged.
+    """
+    live = [y for y in ys if y is not None]
+    if live:
+        fetched = iter(jax.device_get(live))
+        return [np.asarray(next(fetched)) if y is not None else None for y in ys]
+    return [None] * len(ys)
+
+
+def finish_batch(host_y, arrs: list, plans: list) -> list:
+    """Slice per-image outputs out of a fetched (host) batch array.
+
+    Slices are copied: a view would pin the whole fetched group buffer
+    (up to max_group padded images) for as long as any single consumer
+    holds its output, and encoders want contiguous data anyway.
+    """
+    if host_y is None:
+        return [np.asarray(a) for a in arrs]
+    return [np.ascontiguousarray(host_y[i, : p.out_h, : p.out_w]) for i, p in enumerate(plans)]
+
+
 def fetch_batch(y, arrs: list, plans: list) -> list:
     """Block on a launch_batch result and slice out per-image outputs."""
     if y is None:
         return [np.asarray(a) for a in arrs]
-    y = np.asarray(jax.device_get(y))
-    return [y[i, : p.out_h, : p.out_w] for i, p in enumerate(plans)]
+    return finish_batch(np.asarray(jax.device_get(y)), arrs, plans)
 
 
 def run_batch(arrs: list, plans: list, sharding=None) -> list:

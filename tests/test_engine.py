@@ -46,7 +46,7 @@ class TestExecutor:
         assert all(o.shape == (50, 40, 3) for o in outs)
         # all six shared one device dispatch
         assert ex.stats.batches == 1
-        assert ex.stats.max_batch_seen == 6
+        assert ex.stats.max_group_seen == 6
         # different seeds -> different outputs (no cross-item mixing)
         assert not np.array_equal(outs[0], outs[1])
         ex.shutdown()

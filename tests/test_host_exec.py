@@ -1,0 +1,114 @@
+"""Host SIMD spill backend: correctness vs the device path, and the
+executor's cost-model placement policy (engine/host_exec.py, executor.py)."""
+
+import numpy as np
+import pytest
+
+from imaginary_tpu.engine import Executor, ExecutorConfig, host_exec
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops import chain
+from imaginary_tpu.ops.plan import plan_operation
+
+
+def _psnr(a, b):
+    d = a.astype(np.float64) - b.astype(np.float64)
+    mse = (d * d).mean()
+    return 10 * np.log10(255.0**2 / max(mse, 1e-12))
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(42)
+    # smooth-ish content: kernel differences on pure noise are worst-case
+    base = rng.integers(0, 256, (34, 60, 3), np.uint8)
+    big = np.kron(base, np.ones((8, 8, 1), np.uint8))[:270, :480]
+    return np.ascontiguousarray(big)
+
+
+CASES = [
+    ("resize", ImageOptions(width=300, height=200)),
+    ("crop", ImageOptions(width=100, height=120)),
+    ("fit", ImageOptions(width=200, height=200)),
+    ("extract", ImageOptions(top=10, left=20, area_width=200, area_height=100)),
+    ("flip", ImageOptions()),
+    ("flop", ImageOptions()),
+    ("rotate", ImageOptions(rotate=90)),
+    ("blur", ImageOptions(sigma=2.0)),
+    ("zoom", ImageOptions(factor=2)),
+]
+
+
+@pytest.mark.parametrize("name,o", CASES, ids=[c[0] for c in CASES])
+def test_host_matches_device(img, name, o):
+    plan = plan_operation(name, o, img.shape[0], img.shape[1], 1, 3)
+    assert host_exec.can_execute(plan)
+    hy = host_exec.run(img, plan)
+    dy = chain.run_single(img, plan)
+    assert hy.shape == dy.shape
+    assert _psnr(hy, dy) > 28.0, f"{name}: host/device divergence too large"
+
+
+def test_smartcrop_never_spills(img):
+    o = ImageOptions(width=64, height=64)
+    plan = plan_operation("smartcrop", o, img.shape[0], img.shape[1], 1, 3)
+    # interpretable on host (full-host deployments)...
+    assert host_exec.can_execute(plan, for_spill=False)
+    # ...but excluded from load-dependent placement: the crop window must
+    # not depend on link pressure
+    assert not host_exec.can_execute(plan, for_spill=True)
+
+
+def test_spill_triggers_when_device_saturated(img):
+    ex = Executor(ExecutorConfig(host_spill=True, spill_factor=1.0))
+    try:
+        # simulate a measured slow link: 1s per item drain
+        ex._device_item_ms = 1000.0
+        o = ImageOptions(width=64, height=48)
+        plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
+        out = ex.process(img, plan)
+        assert out.shape == (48, 64, 3)
+        assert ex.stats.spilled == 1
+        assert ex.stats.items == 0  # never reached the device queue
+    finally:
+        ex.shutdown()
+
+
+def test_no_spill_when_device_fast(img):
+    ex = Executor(ExecutorConfig(host_spill=True))
+    try:
+        ex._device_item_ms = 0.01  # fast PCIe-class link
+        o = ImageOptions(width=64, height=48)
+        plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
+        out = ex.process(img, plan)
+        assert out.shape == (48, 64, 3)
+        assert ex.stats.spilled == 0
+        assert ex.stats.items == 1
+    finally:
+        ex.shutdown()
+
+
+def test_embed_modes_match_device(img):
+    from imaginary_tpu.options import Extend
+
+    small = img[:100, :150]
+    for extend in (Extend.MIRROR, Extend.COPY, Extend.WHITE, Extend.BLACK,
+                   Extend.BACKGROUND):
+        o = ImageOptions(width=300, height=200, embed=True, extend=extend,
+                         background=(10, 200, 30))
+        o.mark_defined("embed")
+        plan = plan_operation("resize", o, 100, 150, 1, 3)
+        hy = host_exec.run(small, plan)
+        dy = chain.run_single(small, plan)
+        assert hy.shape == dy.shape
+        assert _psnr(hy, dy) > 28.0, extend
+
+
+def test_watermark_composite_matches_device(img):
+    o = ImageOptions(width=200, text="hello tpu", opacity=0.7)
+    plan = plan_operation("watermark", o, img.shape[0], img.shape[1], 1, 3)
+    if not host_exec.can_execute(plan):
+        pytest.skip("composite not host-executable")
+    hy = host_exec.run(img, plan)
+    dy = chain.run_single(img, plan)
+    assert hy.shape == dy.shape
+    assert _psnr(hy, dy) > 25.0
