@@ -13,6 +13,12 @@ test:
 bench:
 	python bench.py
 
+bench-latency:
+	python bench_latency.py
+
+docker:
+	docker build -t imaginary-tpu .
+
 serve:
 	python -m imaginary_tpu --port 9000 --enable-url-source
 

@@ -23,35 +23,23 @@ import time
 import numpy as np
 
 
-def _make_1080p_jpeg() -> bytes:
-    import cv2
-
-    rng = np.random.default_rng(7)
-    yy, xx = np.mgrid[0:1080, 0:1920]
-    img = np.stack(
-        [
-            (xx * 255 / 1919).astype(np.uint8),
-            (yy * 255 / 1079).astype(np.uint8),
-            ((xx + yy) % 256).astype(np.uint8),
-        ],
-        axis=-1,
-    )
-    for _ in range(12):
-        x0, y0 = int(rng.integers(0, 1800)), int(rng.integers(0, 1000))
-        img[y0 : y0 + 80, x0 : x0 + 120] = rng.integers(0, 256, 3)
-    ok, out = cv2.imencode(".jpg", img, [int(cv2.IMWRITE_JPEG_QUALITY), 88])
-    assert ok
-    return out.tobytes()
+from bench_util import make_1080p_jpeg as _make_1080p_jpeg  # noqa: E402
 
 
-def _run_threaded(fn, n_threads: int, duration: float) -> float:
-    """Run fn() in a loop across threads for `duration`s; returns ops/sec."""
+def _run_threaded(fn, n_threads: int, duration: float):
+    """Run fn() in a loop across threads for `duration`s.
+
+    Returns (ops/sec, latencies_ms list) — per-request latency is recorded so
+    the bench reports p50/p99 alongside throughput (BASELINE.json's metric)."""
     stop = time.monotonic() + duration
     counts = [0] * n_threads
+    lats: list = [[] for _ in range(n_threads)]
 
     def worker(i):
         while time.monotonic() < stop:
+            t0 = time.monotonic()
             fn()
+            lats[i].append((time.monotonic() - t0) * 1000.0)
             counts[i] += 1
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
@@ -61,13 +49,18 @@ def _run_threaded(fn, n_threads: int, duration: float) -> float:
     for t in threads:
         t.join()
     elapsed = time.monotonic() - t0
-    return sum(counts) / elapsed
+    all_lats = [x for sub in lats for x in sub]
+    return sum(counts) / elapsed, all_lats
 
 
-def bench_ours(buf: bytes, n_threads: int, duration: float) -> float:
+from bench_util import pctl as _pctl  # noqa: E402
+
+
+def bench_ours(buf: bytes, n_threads: int, duration: float):
     from imaginary_tpu import codecs
     from imaginary_tpu.codecs import EncodeOptions
     from imaginary_tpu.engine import Executor, ExecutorConfig
+    from imaginary_tpu.engine.timing import TIMES
     from imaginary_tpu.imgtype import ImageType
     from imaginary_tpu.options import ImageOptions
     from imaginary_tpu.ops.plan import choose_decode_shrink, plan_operation
@@ -100,9 +93,17 @@ def bench_ours(buf: bytes, n_threads: int, duration: float) -> float:
         for f in futs:
             f.result(timeout=300)
     print(f"[bench] warmup done, backend={codecs.backend_name()}", file=sys.stderr)
-    rate = _run_threaded(one, n_threads, duration)
+    TIMES.reset()
+    # stats must cover ONLY the timed window (warmup items would inflate
+    # the device-vs-spill split the JSON reports)
+    from imaginary_tpu.engine.executor import ExecutorStats
+
+    executor.stats = ExecutorStats()
+    rate, lats = _run_threaded(one, n_threads, duration)
+    stats = executor.stats.to_dict()
+    stages = TIMES.snapshot()
     executor.shutdown()
-    return rate
+    return rate, lats, stats, stages
 
 
 def bench_baseline(buf: bytes, n_threads: int, duration: float) -> float:
@@ -116,7 +117,7 @@ def bench_baseline(buf: bytes, n_threads: int, duration: float) -> float:
         cv2.imencode(".jpg", r, [int(cv2.IMWRITE_JPEG_QUALITY), 80])
 
     one()
-    return _run_threaded(one, n_threads, duration)
+    return _run_threaded(one, n_threads, duration)[0]
 
 
 def _probe_accelerator(timeout: float = 90.0) -> bool:
@@ -157,9 +158,15 @@ def main():
             print(f"[bench] native build error: {e}; using fallback codecs", file=sys.stderr)
 
     platform = os.environ.get("BENCH_PLATFORM", "")
+    fallback = False
     if not platform and not _probe_accelerator():
-        print("[bench] accelerator unreachable; falling back to CPU JAX", file=sys.stderr)
+        # NOT a TPU result past this point — label it unmistakably. The JSON
+        # line carries backend=cpu-fallback and stderr shouts; a CPU number
+        # must never be mistaken for chip performance (VERDICT r1, weak #1).
+        print("[bench] *** ACCELERATOR UNREACHABLE — CPU-JAX FALLBACK; "
+              "this is NOT a TPU measurement ***", file=sys.stderr)
         platform = "cpu"
+        fallback = True
     if platform:
         import jax
 
@@ -169,8 +176,21 @@ def main():
     print(f"[bench] 1080p jpeg = {len(buf)} bytes, threads={n_threads}, "
           f"duration={duration}s, cpus={cpus}", file=sys.stderr)
 
-    ours = bench_ours(buf, n_threads, duration)
-    print(f"[bench] imaginary-tpu: {ours:.2f} req/s", file=sys.stderr)
+    ours, lats, exec_stats, stages = bench_ours(buf, n_threads, duration)
+
+    import jax
+
+    backend = "cpu-fallback" if fallback else jax.default_backend()
+    print(f"[bench] imaginary-tpu: {ours:.2f} req/s on backend={backend} | "
+          f"p50={_pctl(lats, 0.50)}ms p95={_pctl(lats, 0.95)}ms "
+          f"p99={_pctl(lats, 0.99)}ms", file=sys.stderr)
+    print(f"[bench] executor: {exec_stats}", file=sys.stderr)
+    print(f"[bench] device-path items={exec_stats['items']} "
+          f"spilled-to-host={exec_stats['spilled']}", file=sys.stderr)
+    for name, s in stages.items():
+        print(f"[bench]   stage {name:<12} n={s['count']:<6} "
+              f"mean={s['mean_ms']:.2f}ms p50={s['p50_ms']:.2f}ms "
+              f"p99={s['p99_ms']:.2f}ms", file=sys.stderr)
 
     base = bench_baseline(buf, n_threads, duration)
     print(f"[bench] cpu baseline (cv2): {base:.2f} req/s", file=sys.stderr)
@@ -180,6 +200,11 @@ def main():
         "value": round(ours, 2),
         "unit": "req/sec",
         "vs_baseline": round(ours / base, 3) if base > 0 else 0.0,
+        "backend": backend,
+        "device_items": exec_stats["items"],
+        "spilled_items": exec_stats["spilled"],
+        "p50_ms": _pctl(lats, 0.50),
+        "p99_ms": _pctl(lats, 0.99),
     }))
 
 

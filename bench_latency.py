@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Open-loop (fixed-rate) latency harness — the vegeta analogue.
+
+The reference ships `benchmark.sh` (vegeta: 50 rps x 30 s POST of a 1080p
+JPEG against /crop, /resize, /extract — /root/reference/benchmark.sh:16-31).
+This harness reproduces that shape against OUR live HTTP server, plus the
+4-op /pipeline chain of BASELINE.json config #3, and reports p50/p95/p99
+per route. Open-loop means requests fire on a fixed clock regardless of
+completions — queueing delay shows up in the tail instead of silently
+throttling the offered load, which is what the p99 <= 2x-baseline target
+(BASELINE.md) is defined against.
+
+Usage:
+    python bench_latency.py                # 20 rps x 15 s per route
+    BENCH_RATE=50 BENCH_SECS=30 python bench_latency.py
+
+Output: one JSON line per route on stdout; human detail on stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROUTES = [
+    # (name, path+query, method)
+    ("resize", "/resize?width=300&height=200", "POST"),
+    ("crop", "/crop?width=400&height=300", "POST"),
+    ("extract", "/extract?top=100&left=100&areawidth=600&areaheight=400", "POST"),
+    (
+        "pipeline",
+        "/pipeline?operations=" + __import__("urllib.parse", fromlist=["quote"]).quote(
+            json.dumps(
+                [
+                    {"operation": "crop", "params": {"width": 1600, "height": 900}},
+                    {"operation": "resize", "params": {"width": 640}},
+                    {"operation": "blur", "params": {"sigma": 1.5}},
+                    {"operation": "convert", "params": {"type": "jpeg"}},
+                ]
+            )
+        ),
+        "POST",
+    ),
+]
+
+
+from bench_util import make_1080p_jpeg as _make_1080p_jpeg  # noqa: E402
+
+
+from bench_util import pctl as _pctl  # noqa: E402
+
+
+async def _fire(session, url, method, body, lats, errors):
+    t0 = time.monotonic()
+    try:
+        async with session.request(method, url, data=body) as resp:
+            await resp.read()
+            if resp.status != 200:
+                errors.append(resp.status)
+                return
+    except Exception:
+        errors.append(-1)
+        return
+    lats.append((time.monotonic() - t0) * 1000.0)
+
+
+async def run_route(base, name, pathq, method, body, rate, secs):
+    import aiohttp
+
+    lats: list = []
+    errors: list = []
+    interval = 1.0 / rate
+    n = int(rate * secs)
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        tasks = []
+        t_start = time.monotonic()
+        for i in range(n):
+            # fixed-clock schedule: sleep until this request's slot
+            slot = t_start + i * interval
+            delay = slot - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(
+                asyncio.create_task(
+                    _fire(session, base + pathq, method, body, lats, errors)
+                )
+            )
+        await asyncio.gather(*tasks)
+    sent = n
+    ok = len(lats)
+    res = {
+        "metric": f"latency_{name}_1080p_jpeg",
+        "rate_rps": rate,
+        "duration_s": secs,
+        "sent": sent,
+        "ok": ok,
+        "errors": len(errors),
+        "p50_ms": _pctl(lats, 0.50),
+        "p95_ms": _pctl(lats, 0.95),
+        "p99_ms": _pctl(lats, 0.99),
+        "mean_ms": round(sum(lats) / ok, 2) if ok else 0.0,
+    }
+    return res
+
+
+def baseline_latency(buf: bytes, n: int = 100) -> dict:
+    """Single-op cv2 latency distribution on this host — the '1x' the
+    p99 <= 2x target is measured against."""
+    import cv2
+
+    data = np.frombuffer(buf, np.uint8)
+    lats = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        a = cv2.imdecode(data, cv2.IMREAD_COLOR)
+        r = cv2.resize(a, (300, 200), interpolation=cv2.INTER_AREA)
+        cv2.imencode(".jpg", r, [int(cv2.IMWRITE_JPEG_QUALITY), 80])
+        lats.append((time.monotonic() - t0) * 1000.0)
+    return {"p50_ms": _pctl(lats, 0.50), "p99_ms": _pctl(lats, 0.99)}
+
+
+async def main_async():
+    rate = float(os.environ.get("BENCH_RATE", "20"))
+    secs = float(os.environ.get("BENCH_SECS", "15"))
+    port = int(os.environ.get("BENCH_PORT", "8899"))
+
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    from aiohttp import web as aioweb
+
+    from imaginary_tpu.web.app import create_app
+    from imaginary_tpu.web.config import ServerOptions
+
+    o = ServerOptions(port=port)
+    app = create_app(o)
+    runner = aioweb.AppRunner(app)
+    await runner.setup()
+    site = aioweb.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+
+    buf = _make_1080p_jpeg()
+    base_url = f"http://127.0.0.1:{port}"
+
+    # warm every route's compile cache before the clock starts
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        for name, pathq, method in ROUTES:
+            async with s.request(method, base_url + pathq, data=buf) as r:
+                await r.read()
+                if r.status != 200:
+                    print(f"[lat] warmup {name} -> {r.status}", file=sys.stderr)
+
+    base = baseline_latency(buf)
+    print(f"[lat] cv2 baseline: p50={base['p50_ms']}ms p99={base['p99_ms']}ms",
+          file=sys.stderr)
+
+    results = []
+    for name, pathq, method in ROUTES:
+        res = await run_route(base_url, name, pathq, method, buf, rate, secs)
+        res["baseline_p99_ms"] = base["p99_ms"]
+        res["p99_vs_2x_baseline"] = (
+            "PASS" if res["p99_ms"] <= 2 * base["p99_ms"] else "FAIL"
+        )
+        results.append(res)
+        print(f"[lat] {name}: p50={res['p50_ms']} p95={res['p95_ms']} "
+              f"p99={res['p99_ms']} ok={res['ok']}/{res['sent']} "
+              f"({res['p99_vs_2x_baseline']} vs 2x baseline p99)", file=sys.stderr)
+
+    await runner.cleanup()
+    for res in results:
+        print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    asyncio.run(main_async())

@@ -1,0 +1,40 @@
+"""Shared helpers for the benchmark harnesses (bench.py, bench_latency.py).
+
+One definition of the synthetic 1080p workload image and of percentile math,
+so throughput and latency benches measure the same thing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_1080p_jpeg(quality: int = 88) -> bytes:
+    """Deterministic 1920x1080 JPEG with gradient structure + blocky detail
+    (compresses like a photo, not like noise)."""
+    import cv2
+
+    rng = np.random.default_rng(7)
+    yy, xx = np.mgrid[0:1080, 0:1920]
+    img = np.stack(
+        [
+            (xx * 255 / 1919).astype(np.uint8),
+            (yy * 255 / 1079).astype(np.uint8),
+            ((xx + yy) % 256).astype(np.uint8),
+        ],
+        axis=-1,
+    )
+    for _ in range(12):
+        x0, y0 = int(rng.integers(0, 1800)), int(rng.integers(0, 1000))
+        img[y0 : y0 + 80, x0 : x0 + 120] = rng.integers(0, 256, 3)
+    ok, out = cv2.imencode(".jpg", img, [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    assert ok
+    return out.tobytes()
+
+
+def pctl(lats, q: float) -> float:
+    """Nearest-rank percentile of a latency list, rounded to 0.01 ms."""
+    if not lats:
+        return 0.0
+    s = sorted(lats)
+    return round(s[min(len(s) - 1, int(q * (len(s) - 1)))], 2)

@@ -64,7 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-batch", type=int, default=8, help="micro-batch size cap")
     p.add_argument("--use-mesh", action="store_true", help="shard batches over the device mesh")
     p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
+    p.add_argument("--spatial", type=int, default=1,
+                   help="spatial mesh axis size (W-shard huge images across chips)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
+    p.add_argument("--distributed", action="store_true",
+                   help="join a multi-host fleet (jax.distributed.initialize before meshing)")
+    p.add_argument("--coordinator-address", default="",
+                   help="host:port of process 0 (auto-discovered on TPU pods)")
+    p.add_argument("--num-processes", type=int, default=0,
+                   help="total process count (auto-discovered on TPU pods)")
+    p.add_argument("--process-id", type=int, default=-1,
+                   help="this process's index (auto-discovered on TPU pods)")
     return p
 
 
@@ -130,7 +140,12 @@ def options_from_args(args) -> ServerOptions:
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,
         n_devices=args.devices or None,
+        spatial=max(1, args.spatial),
         prewarm=args.prewarm,
+        distributed=args.distributed,
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes or None,
+        process_id=args.process_id if args.process_id >= 0 else None,
     )
 
 
@@ -150,6 +165,17 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", platform)
+
+    if o.distributed:
+        # must run before any jax backend initialization so every process
+        # sees the global device set (SURVEY.md section 5.8)
+        from imaginary_tpu.parallel.mesh import init_distributed
+
+        init_distributed(
+            coordinator_address=o.coordinator_address or None,
+            num_processes=o.num_processes,
+            process_id=o.process_id,
+        )
 
     from imaginary_tpu.prewarm import enable_persistent_cache
 

@@ -99,6 +99,16 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
             im.save(out, "TIFF")
         elif t == ImageType.GIF:
             im.save(out, "GIF")
+        elif t == ImageType.AVIF:
+            # PIL's avif plugin when compiled in; otherwise the CodecError
+            # triggers the documented AVIF->JPEG fallback (image.go:99-103).
+            # `speed` maps to the AVIF effort knob like the reference's
+            # bimg.Options.Speed — where 0 also means "unset/default"
+            # (params.go parses ints with 0 default and bimg only forwards
+            # non-zero Speed), so speed=0 -> encoder default, matching the
+            # reference's wire contract rather than raw libavif semantics.
+            im.save(out, "AVIF", quality=opts.effective_quality(),
+                    speed=max(1, min(opts.speed, 10)) if opts.speed else 6)
         else:
             raise CodecError(f"Unsupported output image format: {t.value}", 400)
     except CodecError:

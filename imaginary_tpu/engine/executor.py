@@ -24,6 +24,7 @@ from typing import Optional
 import numpy as np
 
 from imaginary_tpu.engine import host_exec
+from imaginary_tpu.engine.timing import TIMES
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
 from imaginary_tpu.ops.plan import ImagePlan
@@ -39,6 +40,12 @@ class ExecutorConfig:
     use_mesh: bool = False  # shard micro-batches over the device mesh
     n_devices: Optional[int] = None  # None = all devices
     spatial: int = 1  # spatial mesh axis size (sp sharding for huge images)
+    # Buckets with >= this many pixels also shard the image W axis across
+    # the mesh's spatial axis (the long-context analogue, SURVEY.md section
+    # 5.7): the sampling-matrix einsums contract over W, so each device
+    # holds a W-slice and XLA inserts the cross-device reduction. Default
+    # = 4K-class inputs (3840*2160).
+    spatial_threshold_px: int = 3840 * 2160
     # Cost-model placement: the device path is primary, but placement is
     # decided per item from MEASURED costs. The fetcher maintains an EWMA of
     # device per-item drain time (the D2H readback is the scarce resource);
@@ -49,9 +56,13 @@ class ExecutorConfig:
     # rides the device; on a slow tunneled link the device absorbs exactly
     # its drain rate and the host soaks up the rest. Every probe_interval-th
     # spill-eligible item rides the device anyway to refresh the estimate.
-    host_spill: bool = True
+    # None = auto: spill only when the host has spare cores to soak excess
+    # load (>= 4 CPUs). On a 1-2 CPU host every spilled image's ~15 ms of
+    # SIMD work is stolen from the device path's decode/encode budget — the
+    # "spare" resource the spill policy assumes does not exist.
+    host_spill: Optional[bool] = None
     spill_factor: float = 6.0
-    probe_interval: int = 256
+    probe_interval: int = 64
 
 
 @dataclasses.dataclass
@@ -63,6 +74,8 @@ class ExecutorStats:
     queue_depth: int = 0
     compile_cache_size: int = 0
     spilled: int = 0
+    spill_errors: int = 0  # host-spill attempts that fell back to the device
+    spatial_batches: int = 0  # device calls that W-sharded over the mesh
     device_item_ms: float = 0.0  # measured per-item drain cost (cost model)
     host_item_ms: float = 0.0  # measured host-spill execution cost
 
@@ -77,6 +90,8 @@ class ExecutorStats:
             "queue_depth": self.queue_depth,
             "compile_cache_size": chain_mod.cache_size(),
             "spilled": self.spilled,
+            "spill_errors": self.spill_errors,
+            "spatial_batches": self.spatial_batches,
             "device_item_ms": round(self.device_item_ms, 3),
             "host_item_ms": round(self.host_item_ms, 3),
         }
@@ -99,16 +114,33 @@ class Executor:
 
     def __init__(self, config: Optional[ExecutorConfig] = None):
         self.config = config or ExecutorConfig()
+        if self.config.host_spill is None:
+            import os
+
+            self.config = dataclasses.replace(
+                self.config, host_spill=(os.cpu_count() or 1) >= 4
+            )
         self.stats = ExecutorStats()
         self._queue: queue_mod.Queue = queue_mod.Queue()
         self._sharding = None
+        self._spatial_sharding = None
         self._mesh_batch = 1
+        self._mesh_spatial = 1
         if self.config.use_mesh:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             from imaginary_tpu.parallel import batch_sharding, get_mesh
 
             mesh = get_mesh(self.config.n_devices, self.config.spatial)
             self._sharding = batch_sharding(mesh)
             self._mesh_batch = mesh.devices.shape[0]
+            self._mesh_spatial = mesh.devices.shape[1]
+            if mesh.devices.shape[1] > 1:
+                # (batch, H, W, C) with W split over the spatial axis —
+                # same partitioning the driver dryrun validates numerically
+                self._spatial_sharding = NamedSharding(
+                    mesh, PartitionSpec("batch", None, "spatial", None)
+                )
         self._running = True
         # Launched-but-unfetched groups ride this bounded queue: the
         # collector keeps dispatching (H2D + compute are cheap and async)
@@ -148,16 +180,21 @@ class Executor:
         if self.config.host_spill and self._should_spill(plan):
             t0 = time.monotonic()
             try:
-                item.future.set_result(host_exec.run(arr, plan))
-            except Exception as e:
-                item.future.set_exception(e)
+                out = host_exec.run(arr, plan)
+            except Exception:
+                # A host-interpreter edge case must not become a user-visible
+                # 500 that only reproduces under link load — the device path
+                # can still serve this item. Fall through to the queue.
+                self.stats.spill_errors += 1
             else:
                 ms = (time.monotonic() - t0) * 1000.0
+                TIMES.record("host_spill", ms)
                 with self._owed_lock:
                     self._host_item_ms = 0.8 * self._host_item_ms + 0.2 * ms
                     self.stats.host_item_ms = self._host_item_ms
-            self.stats.spilled += 1
-            return item.future
+                self.stats.spilled += 1
+                item.future.set_result(out)
+                return item.future
         with self._owed_lock:
             self._device_owed += 1
         item.future.add_done_callback(self._on_done)
@@ -172,8 +209,11 @@ class Executor:
         dev_ms = self._device_item_ms
         if dev_ms is None:  # device cost unknown: it is the primary path
             return False
-        wait_ms = (self._device_owed + 1) * dev_ms
-        if wait_ms <= self.config.spill_factor * self._host_item_ms:
+        with self._owed_lock:
+            owed = self._device_owed
+            host_ms = self._host_item_ms
+        wait_ms = (owed + 1) * dev_ms
+        if wait_ms <= self.config.spill_factor * host_ms:
             return False
         if not host_exec.can_execute(plan):
             return False
@@ -281,7 +321,17 @@ class Executor:
         if target > n:
             arrs = arrs + [arrs[-1]] * (target - n)
             plans = plans + [plans[-1]] * (target - n)
-        y = chain_mod.launch_batch(arrs, plans, sharding=self._sharding)
+        sharding = self._sharding
+        _, hb, wb, _c = items[0].key
+        if (
+            self._spatial_sharding is not None
+            and hb * wb >= self.config.spatial_threshold_px
+            # device_put rejects uneven sharding: W must split evenly
+            and wb % self._mesh_spatial == 0
+        ):
+            sharding = self._spatial_sharding
+            self.stats.spatial_batches += 1
+        y = chain_mod.launch_batch(arrs, plans, sharding=sharding)
         return y, arrs, plans
 
     def _dispatch(self, items: list):
@@ -291,6 +341,10 @@ class Executor:
         a serial per-buffer fetch, and the per-drain fixed cost amortizes
         over the group, not the chunk)."""
         chunks = []
+        now = time.monotonic()
+        for it in items:
+            TIMES.record("queue_wait", (now - it.t) * 1000.0)
+        cache_before = chain_mod.cache_size()
         try:
             for start in range(0, len(items), self.config.max_batch):
                 sub = items[start : start + self.config.max_batch]
@@ -300,6 +354,11 @@ class Executor:
             for it in items:
                 it.future.set_exception(e)
             return
+        # A cache-size bump means this group's launch paid an XLA compile;
+        # its drain time must not seed the cost model (a multi-second compile
+        # divided over one group would lock thousands of requests into host
+        # spill before the EWMA recovered — ADVICE r1).
+        cold = chain_mod.cache_size() > cache_before
         self.stats.items += len(items)
         self.stats.groups += 1
         self.stats.batches += len(chunks)
@@ -307,16 +366,19 @@ class Executor:
         with self._inflight_lock:
             self._inflight += 1
         # blocks when max_inflight groups are queued: natural backpressure
-        self._fetch_queue.put(chunks)
+        self._fetch_queue.put((chunks, cold))
 
     def _fetch_loop(self):
         while True:
             got = self._fetch_queue.get()
             if got is None:
                 break
-            chunks = got
+            chunks, cold = got
+            n_items = sum(len(c[3]) for c in chunks)
             t0 = time.monotonic()
             try:
+                chain_mod.ready_groups([c[0] for c in chunks])
+                t_ready = time.monotonic()
                 fetched = chain_mod.fetch_groups([c[0] for c in chunks])
             except Exception as e:
                 for _, _, _, sub in chunks:
@@ -333,12 +395,22 @@ class Executor:
             # light-load traffic keeps riding the device; under real load
             # groups are full and the estimate converges to the true
             # amortized cost.
-            n_items = sum(len(c[3]) for c in chunks)
+            t_done = time.monotonic()
+            if not cold:
+                TIMES.record("device_wait", (t_ready - t0) * 1000.0 / max(1, n_items))
+                TIMES.record("d2h", (t_done - t_ready) * 1000.0 / max(1, n_items))
             n_eff = max(n_items, self.config.max_group // 2)
-            ms = (time.monotonic() - t0) * 1000.0 / max(1, n_eff)
+            ms = (t_done - t0) * 1000.0 / max(1, n_eff)
             prev = self._device_item_ms
-            self._device_item_ms = ms if prev is None else 0.7 * prev + 0.3 * ms
-            self.stats.device_item_ms = self._device_item_ms
+            if cold:
+                pass  # compile-inclusive drain: not a link-cost sample
+            else:
+                if prev is not None and ms > 4.0 * prev:
+                    # clamp outlier samples (GC pause, tunnel hiccup) so one
+                    # bad drain can't flip the placement policy wholesale
+                    ms = 4.0 * prev
+                self._device_item_ms = ms if prev is None else 0.7 * prev + 0.3 * ms
+                self.stats.device_item_ms = self._device_item_ms
             for host_y, (y, arrs, plans, sub) in zip(fetched, chunks):
                 try:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)

@@ -105,10 +105,12 @@ def _apply(spec, x, dyn):
         dh, dw = int(dyn["dst_h"]), int(dyn["dst_w"])
         if (dh, dw) == x.shape[:2]:
             return x
-        if _HAS_CV2:
+        shrink_h = dh < x.shape[0]
+        shrink_w = dw < x.shape[1]
+        if _HAS_CV2 and (spec.kernel == "nearest" or shrink_h == shrink_w):
             if spec.kernel == "nearest":
                 interp = cv2.INTER_NEAREST
-            elif dh < x.shape[0] and dw < x.shape[1]:
+            elif shrink_h and shrink_w:
                 # minification: area averaging is the host analogue of the
                 # device's stretched-kernel (antialiased) resample
                 interp = cv2.INTER_AREA
@@ -118,6 +120,9 @@ def _apply(spec, x, dyn):
             if out.ndim == 2:  # cv2 drops a trailing singleton channel
                 out = out[:, :, None]
             return out
+        # Mixed shrink/enlarge (exactly one axis minified): cv2 offers no
+        # per-axis antialiasing, so use the exact stretched-kernel port —
+        # the device path antialiases each axis independently.
         return _np_resize(x, dh, dw, spec.kernel)
 
     if isinstance(spec, ExtractSpec):

@@ -1,0 +1,108 @@
+"""Per-stage timing aggregation (SURVEY.md section 5.1).
+
+The reference logs only whole-request latency (log.go:80-85). For a
+device-backed service the actionable split is per stage of the request's
+journey: probe/decode on host, queue wait, device wait (H2D + compute),
+D2H readback, encode. Each stage records into a bounded ring so /health can
+report count/mean/p50/p99 without unbounded memory, and the bench can print
+an honest breakdown of where time goes.
+
+A `jax.profiler` trace can be captured around the whole serving loop by
+setting IMAGINARY_TPU_PROFILE_DIR; see `maybe_start_profiler`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_RING = 2048  # samples kept per stage for percentile estimates
+
+STAGES = (
+    "probe",        # header-only metadata parse
+    "decode",       # host codec decode (incl. shrink-on-load)
+    "queue_wait",   # submit -> device-call launch
+    "device_wait",  # fetch start -> outputs ready (H2D + compute, amortized/item)
+    "d2h",          # device->host readback (amortized/item)
+    "host_spill",   # host SIMD interpreter execution (spilled items)
+    "encode",       # host codec encode
+    "total",        # whole processing call
+)
+
+
+class StageTimes:
+    """Thread-safe per-stage latency aggregator."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sum = {s: 0.0 for s in STAGES}
+        self._count = {s: 0 for s in STAGES}
+        self._ring = {s: np.zeros(_RING, dtype=np.float32) for s in STAGES}
+        self._pos = {s: 0 for s in STAGES}
+
+    def record(self, stage: str, ms: float) -> None:
+        with self._lock:
+            self._sum[stage] += ms
+            c = self._count[stage]
+            self._count[stage] = c + 1
+            ring = self._ring[stage]
+            ring[self._pos[stage]] = ms
+            self._pos[stage] = (self._pos[stage] + 1) % _RING
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            for s in STAGES:
+                c = self._count[s]
+                if not c:
+                    continue
+                n = min(c, _RING)
+                window = np.sort(self._ring[s][:n])
+                out[s] = {
+                    "count": c,
+                    "mean_ms": round(self._sum[s] / c, 3),
+                    "p50_ms": round(float(window[int(0.50 * (n - 1))]), 3),
+                    "p99_ms": round(float(window[int(0.99 * (n - 1))]), 3),
+                }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            for s in STAGES:
+                self._sum[s] = 0.0
+                self._count[s] = 0
+                self._pos[s] = 0
+
+
+# Process-wide registry: the pipeline, executor, and /health all share it.
+TIMES = StageTimes()
+
+_profiler_started = False
+
+
+def maybe_start_profiler() -> bool:
+    """Start a jax.profiler trace if IMAGINARY_TPU_PROFILE_DIR is set.
+
+    The trace covers everything until stop_profiler() (or process exit);
+    inspect with TensorBoard or xprof. Returns True if a trace started.
+    """
+    global _profiler_started
+    trace_dir = os.environ.get("IMAGINARY_TPU_PROFILE_DIR")
+    if not trace_dir or _profiler_started:
+        return False
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    _profiler_started = True
+    return True
+
+
+def stop_profiler() -> None:
+    global _profiler_started
+    if _profiler_started:
+        import jax
+
+        jax.profiler.stop_trace()
+        _profiler_started = False

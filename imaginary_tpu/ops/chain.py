@@ -99,16 +99,38 @@ def launch_batch(arrs: list, plans: list, sharding=None):
     w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
     dyns = _stack_dyns(plans)
     if sharding is not None:
+        # `sharding` may partition more than the batch axis (spatial
+        # W-sharding for huge buckets). Per-item vectors and dyn params are
+        # 1-D/low-rank: they shard on the batch axis only.
+        vec_sharding = sharding
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(sharding, NamedSharding) and len(sharding.spec) > 1:
+            vec_sharding = NamedSharding(sharding.mesh, PartitionSpec(sharding.spec[0]))
         batch = jax.device_put(batch, sharding)
-        h = jax.device_put(h, sharding)
-        w = jax.device_put(w, sharding)
-        dyns = tuple({k: jax.device_put(v, sharding) for k, v in d.items()} for d in dyns)
+        h = jax.device_put(h, vec_sharding)
+        w = jax.device_put(w, vec_sharding)
+        dyns = tuple(
+            {k: jax.device_put(v, vec_sharding) for k, v in d.items()} for d in dyns
+        )
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
     fn = _compiled(specs, batch.shape, dyn_key)
     y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
     return y
+
+
+def ready_groups(ys: list) -> None:
+    """Block until every launch_batch output has finished computing.
+
+    Separating "wait for compute" from the device_get readback lets the
+    executor time H2D+compute and D2H independently (SURVEY.md section 5.1's
+    per-stage split) — the two bottlenecks need different fixes.
+    """
+    for y in ys:
+        if y is not None:
+            y.block_until_ready()
 
 
 def fetch_groups(ys: list) -> list:

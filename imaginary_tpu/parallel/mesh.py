@@ -23,6 +23,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+_distributed_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host service fleet (SURVEY.md section 5.8's TPU-native
+    equivalent of the reference's LB-level horizontal scaling).
+
+    Wraps jax.distributed.initialize: after this, jax.devices() spans every
+    host's chips and get_mesh() builds one global mesh — batch-dp collectives
+    ride ICI within a slice and DCN across hosts. On TPU pods all three
+    arguments auto-discover from the TPU metadata; pass them explicitly for
+    CPU/GPU fleets or tests. Idempotent per process.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
+
+
 @functools.lru_cache(maxsize=None)
 def get_mesh(n_devices: Optional[int] = None, spatial: int = 1) -> Mesh:
     """Build a (batch, spatial) mesh over the first n_devices devices."""

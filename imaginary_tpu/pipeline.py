@@ -16,11 +16,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from imaginary_tpu import codecs
+from imaginary_tpu.engine.timing import TIMES
 from imaginary_tpu.codecs import EncodeOptions
 from imaginary_tpu.errors import ImageError, new_error
 from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
@@ -74,6 +76,7 @@ def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedIma
         speed=o.speed,
         strip_metadata=o.strip_metadata,
     )
+    t0 = time.monotonic()
     try:
         body = codecs.encode(arr, opts)
         actual = target
@@ -84,6 +87,7 @@ def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedIma
             actual = ImageType.JPEG
         else:
             raise
+    TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
     return ProcessedImage(body=body, mime=get_image_mime_type(actual))
 
 
@@ -127,14 +131,22 @@ def process_operation(
     if name not in OPERATION_NAMES:
         raise new_error(f"Unsupported operation: {name}", 400)
 
-    d = codecs.decode(buf, _pick_shrink(name, buf, o))
+    t_start = time.monotonic()
+    shrink = _pick_shrink(name, buf, o)
+    t_probe = time.monotonic()
+    d = codecs.decode(buf, shrink)
+    t_decode = time.monotonic()
+    TIMES.record("probe", (t_probe - t_start) * 1000.0)
+    TIMES.record("decode", (t_decode - t_probe) * 1000.0)
     wm = _fetch_watermark(name, o, watermark_fetcher)
     plan = plan_operation(
         name, o, d.array.shape[0], d.array.shape[1], d.orientation,
         d.array.shape[2], watermark_rgba=wm,
     )
     arr = _run_stages(d.array, plan, runner)
-    return _encode(arr, o, _encode_type(o, d.type))
+    out = _encode(arr, o, _encode_type(o, d.type))
+    TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
+    return out
 
 
 def _pick_shrink(name: str, buf: bytes, o: ImageOptions) -> int:
@@ -143,10 +155,11 @@ def _pick_shrink(name: str, buf: bytes, o: ImageOptions) -> int:
     A header-only probe supplies source dims/orientation; the planner then
     proves (by re-planning) that decoding at 1/N preserves the output. Pays
     one extra header parse (~0.1 ms) to avoid decoding/moving up to 64x the
-    pixels the chain will immediately throw away."""
+    pixels the chain will immediately throw away. Applies to JPEG (DCT
+    scaling) and SVG (vector render straight into the 1/N box)."""
     from imaginary_tpu.imgtype import determine_image_type
 
-    if determine_image_type(buf) is not ImageType.JPEG:
+    if determine_image_type(buf) not in (ImageType.JPEG, ImageType.SVG):
         return 1
     try:
         meta = codecs.probe(buf)

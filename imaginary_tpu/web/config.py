@@ -50,7 +50,13 @@ class ServerOptions:
     max_batch: int = 8
     use_mesh: bool = False
     n_devices: Optional[int] = None
+    spatial: int = 1  # spatial mesh axis (W-sharding for >=4K inputs)
     prewarm: bool = False
+    # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
+    distributed: bool = False
+    coordinator_address: str = ""
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def is_endpoint_enabled(self, path: str) -> bool:
         """Endpoint disabling by last path segment (ref: server.go:57-66)."""

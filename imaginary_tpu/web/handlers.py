@@ -75,6 +75,7 @@ class ImageService:
                 max_batch=o.max_batch,
                 use_mesh=o.use_mesh,
                 n_devices=o.n_devices,
+                spatial=o.spatial,
             )
         )
         import os as _os

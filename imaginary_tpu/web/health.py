@@ -47,4 +47,9 @@ def get_health_stats(executor=None) -> dict:
         stats["backend"] = "unavailable"
     if executor is not None:
         stats["executor"] = executor.stats.to_dict()
+    from imaginary_tpu.engine.timing import TIMES
+
+    stage_times = TIMES.snapshot()
+    if stage_times:
+        stats["stageTimesMs"] = stage_times
     return stats

@@ -76,6 +76,58 @@ def generate_all(out_dir: str) -> None:
         exif[274] = 6  # 274 = Orientation tag
         im.save(exif_path, quality=90, exif=exif)
 
+    # SVG fixture (the reference ships flyio-button.svg; ours is a small
+    # deterministic vector with known intrinsic size + colors).
+    svg_path = os.path.join(out_dir, "button.svg")
+    if not os.path.exists(svg_path):
+        with open(svg_path, "wb") as f:
+            f.write(
+                b'<svg xmlns="http://www.w3.org/2000/svg" width="240" height="160">'
+                b'<rect x="0" y="0" width="240" height="160" fill="#102030"/>'
+                b'<rect x="20" y="40" width="200" height="80" rx="12" fill="#e03131"/>'
+                b'<circle cx="120" cy="80" r="24" fill="#2f9e44"/></svg>'
+            )
+
+    # AVIF fixture via PIL's avif plugin (skipped silently if absent).
+    avif_path = os.path.join(out_dir, "test.avif")
+    if not os.path.exists(avif_path):
+        try:
+            Image.fromarray(_base_array(320, 240, seed=8)).save(avif_path, quality=85)
+        except Exception:
+            pass
+
+    # Minimal single-page PDF (240x160 pt red rectangle) written by hand —
+    # enough for MediaBox probing everywhere and poppler rendering where
+    # poppler-glib exists.
+    pdf_path = os.path.join(out_dir, "page.pdf")
+    if not os.path.exists(pdf_path):
+        content = b"1 0 0 RG 0.88 0.19 0.19 rg 20 40 200 80 re f"
+        objs = [
+            b"<< /Type /Catalog /Pages 2 0 R >>",
+            b"<< /Type /Pages /Kids [3 0 R] /Count 1 >>",
+            b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 240 160] "
+            b"/Contents 4 0 R >>",
+            b"<< /Length " + str(len(content)).encode() + b" >>\nstream\n"
+            + content + b"\nendstream",
+        ]
+        out = bytearray(b"%PDF-1.4\n")
+        offsets = []
+        for i, body in enumerate(objs, start=1):
+            offsets.append(len(out))
+            out += str(i).encode() + b" 0 obj\n" + body + b"\nendobj\n"
+        xref_at = len(out)
+        out += b"xref\n0 " + str(len(objs) + 1).encode() + b"\n"
+        out += b"0000000000 65535 f \n"
+        for off in offsets:
+            out += ("%010d 00000 n \n" % off).encode()
+        out += (
+            b"trailer\n<< /Size " + str(len(objs) + 1).encode()
+            + b" /Root 1 0 R >>\nstartxref\n" + str(xref_at).encode()
+            + b"\n%%EOF\n"
+        )
+        with open(pdf_path, "wb") as f:
+            f.write(bytes(out))
+
     # Exactly 1024 bytes of non-image data (size-limit fixture,
     # source_http_test.go:270-298).
     kb_path = os.path.join(out_dir, "1024bytes")

@@ -143,3 +143,132 @@ class TestMeshExecutor:
         assert all(o.shape == (32, 32, 3) for o in outs)
         assert ex.stats.items == 5
         ex.shutdown()
+
+
+class TestSpillPolicy:
+    def test_spill_error_falls_through_to_device(self, monkeypatch):
+        """A host-interpreter failure must not fail the request: the item
+        re-routes to the device queue (ADVICE r1 medium #2)."""
+        from imaginary_tpu.engine import executor as ex_mod
+
+        ex = Executor(ExecutorConfig(window_ms=1, probe_interval=10**9, host_spill=True))
+        # force the cost model into "spill everything" territory
+        ex._device_item_ms = 1000.0
+        ex._host_item_ms = 0.01
+        monkeypatch.setattr(
+            ex_mod.host_exec, "run",
+            lambda arr, plan: (_ for _ in ()).throw(RuntimeError("edge case")),
+        )
+        out = ex.process(_img(100, 80), _resize_plan(100, 80, 40))
+        assert out.shape == (50, 40, 3)
+        assert ex.stats.spill_errors == 1
+        assert ex.stats.spilled == 0  # failed spill is not a successful spill
+        ex.shutdown()
+
+    def test_successful_spill_counts(self):
+        ex = Executor(ExecutorConfig(window_ms=1, probe_interval=10**9, host_spill=True))
+        ex._device_item_ms = 1000.0
+        ex._host_item_ms = 0.01
+        out = ex.process(_img(100, 80), _resize_plan(100, 80, 40))
+        assert out.shape == (50, 40, 3)
+        assert ex.stats.spilled == 1
+        assert ex.stats.spill_errors == 0
+        ex.shutdown()
+
+    def test_cold_compile_does_not_seed_cost_model(self):
+        """The first drain of a never-seen chain signature pays XLA compile;
+        that sample must not enter device_item_ms (ADVICE r1 medium #1)."""
+        from imaginary_tpu.ops import chain as chain_mod
+
+        chain_mod.clear_cache()
+        ex = Executor(ExecutorConfig(window_ms=1))
+        ex.process(_img(100, 80), _resize_plan(100, 80, 40))
+        # give the fetcher a beat to finish booking the drain
+        import time as _t
+
+        for _ in range(100):
+            if ex.stats.groups >= 1:
+                break
+            _t.sleep(0.01)
+        assert ex._device_item_ms is None  # cold drain excluded
+        # a second, warm drain seeds it
+        ex.process(_img(100, 80, seed=1), _resize_plan(100, 80, 40))
+        for _ in range(100):
+            if ex._device_item_ms is not None:
+                break
+            _t.sleep(0.01)
+        assert ex._device_item_ms is not None
+        ex.shutdown()
+
+
+class TestStageTimes:
+    def test_executor_records_stage_times(self):
+        from imaginary_tpu.engine.timing import TIMES
+
+        TIMES.reset()
+        ex = Executor(ExecutorConfig(window_ms=1))
+        ex.process(_img(100, 80), _resize_plan(100, 80, 40))
+        ex.process(_img(100, 80, seed=1), _resize_plan(100, 80, 40))
+        snap = TIMES.snapshot()
+        assert snap["queue_wait"]["count"] == 2
+        # warm (non-cold) drains record the device_wait/d2h split
+        assert "device_wait" in snap and "d2h" in snap
+        assert snap["device_wait"]["mean_ms"] >= 0.0
+        ex.shutdown()
+
+
+class TestSpatialServing:
+    """Spatial (W-axis) sharding on the serving path (VERDICT r1 next #6):
+    large buckets route through the (batch x spatial) mesh; output must be
+    bit-identical to unsharded execution."""
+
+    def test_large_bucket_routes_spatially_and_matches(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        arr = _img(256, 512, seed=3)
+        plan = plan_operation(
+            "resize", ImageOptions(width=128, sigma=1.2), 256, 512, 0, 3
+        )
+        ex_sp = Executor(ExecutorConfig(
+            window_ms=1, use_mesh=True, spatial=2, spatial_threshold_px=1,
+        ))
+        out_sp = ex_sp.process(arr, plan)
+        assert ex_sp.stats.spatial_batches >= 1
+        ex_sp.shutdown()
+
+        ex_plain = Executor(ExecutorConfig(window_ms=1))
+        out_plain = ex_plain.process(arr, plan)
+        assert ex_plain.stats.spatial_batches == 0
+        ex_plain.shutdown()
+
+        np.testing.assert_array_equal(out_sp, out_plain)
+
+    def test_small_bucket_stays_batch_sharded(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        ex = Executor(ExecutorConfig(window_ms=1, use_mesh=True, spatial=2))
+        out = ex.process(_img(100, 80), _resize_plan(100, 80, 40))
+        assert out.shape == (50, 40, 3)
+        assert ex.stats.spatial_batches == 0
+        ex.shutdown()
+
+    def test_uneven_spatial_falls_back_to_batch_sharding(self):
+        """W not divisible by the spatial axis: device_put would reject the
+        sharding, so the dispatcher must fall back to batch-only (review r2)."""
+        import jax
+
+        if len(jax.devices()) < 6:
+            pytest.skip("needs >= 6 devices")
+        ex = Executor(ExecutorConfig(
+            window_ms=1, use_mesh=True, n_devices=6, spatial=3,
+            spatial_threshold_px=1,
+        ))
+        # bucket W for a 62-wide image is 64 — not a multiple of 3
+        out = ex.process(_img(100, 62), _resize_plan(100, 62, 40))
+        assert out.shape == (65, 40, 3)
+        assert ex.stats.spatial_batches == 0
+        ex.shutdown()

@@ -1,0 +1,181 @@
+"""Golden quality-parity suite (VERDICT r1 next #7; SURVEY.md section 7
+hard-part 6).
+
+The reference's correctness oracle is libvips output dimensions
+(image_test.go:8-142) — it never asserts pixels. We go further: every dense
+op is compared quantitatively against an independent oracle:
+
+- geometric ops (crop/extract/flip/flop/rot90) must match numpy EXACTLY;
+- resampling ops (resize/enlarge/thumbnail) must reach a PSNR floor against
+  PIL's Lanczos resampler — an independent high-quality implementation of
+  the same kernel family libvips uses for reductions;
+- gaussian blur must reach a PSNR floor against a dense float64 separable
+  convolution built directly from the kernel definition;
+- smartcrop's chosen window must cover the known salient region of the
+  generated fixture (the libvips-attention agreement proxy available
+  without libvips on the host).
+
+PSNR floors are deliberately conservative: they catch kernel regressions
+(wrong phase, missing antialias, integer truncation) while tolerating
+legitimate implementation differences between resample kernels.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.plan import plan_operation
+
+
+def _img(h, w, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 256, (h // 8 + 1, w // 8 + 1, 3), dtype=np.uint8)
+    # smooth structure (pure noise makes PSNR meaningless for resampling)
+    im = Image.fromarray(base).resize((w, h), Image.BICUBIC)
+    return np.asarray(im)
+
+
+def _run(name, opts, arr):
+    plan = plan_operation(name, opts, arr.shape[0], arr.shape[1], 0, arr.shape[2])
+    return chain_mod.run_single(arr, plan)
+
+
+def psnr(a: np.ndarray, b: np.ndarray) -> float:
+    assert a.shape == b.shape, (a.shape, b.shape)
+    d = a.astype(np.float64) - b.astype(np.float64)
+    mse = np.mean(d * d)
+    if mse == 0:
+        return 99.0
+    return 10.0 * np.log10(255.0 * 255.0 / mse)
+
+
+class TestResamplePSNR:
+    @pytest.mark.parametrize(
+        "src,dst",
+        [
+            ((400, 600), (200, 300)),   # clean 2x minify
+            ((400, 600), (150, 225)),   # fractional minify
+            ((300, 400), (120, 160)),   # ~2.6x minify
+            ((120, 160), (300, 400)),   # enlarge
+        ],
+    )
+    def test_resize_vs_pil_lanczos(self, src, dst):
+        arr = _img(*src, seed=1)
+        out = _run("resize", ImageOptions(width=dst[1], height=dst[0], force=True), arr)
+        oracle = np.asarray(
+            Image.fromarray(arr).resize((dst[1], dst[0]), Image.LANCZOS)
+        )
+        p = psnr(out, oracle)
+        assert p >= 30.0, f"resize {src}->{dst} PSNR {p:.1f} dB < 30"
+
+    def test_thumbnail_vs_pil(self):
+        arr = _img(400, 600, seed=2)
+        out = _run("thumbnail", ImageOptions(width=100), arr)
+        oracle = np.asarray(
+            Image.fromarray(arr).resize((out.shape[1], out.shape[0]), Image.LANCZOS)
+        )
+        p = psnr(out, oracle)
+        assert p >= 30.0, f"thumbnail PSNR {p:.1f} dB < 30"
+
+
+class TestGeometricExact:
+    def test_crop_vs_cover_oracle(self):
+        # bimg crop = resize-to-fill then centre crop (image.go:226-234 sets
+        # Width/Height + Crop=true): compare against the same cover
+        # transform built from PIL lanczos + an exact centre slice
+        arr = _img(300, 400, seed=3)
+        out = _run("crop", ImageOptions(width=200, height=120), arr)
+        assert out.shape[:2] == (120, 200)
+        scale = max(200 / 400, 120 / 300)
+        rw, rh = round(400 * scale), round(300 * scale)
+        resized = np.asarray(Image.fromarray(arr).resize((rw, rh), Image.LANCZOS))
+        top, left = (rh - 120) // 2, (rw - 200) // 2
+        oracle = resized[top : top + 120, left : left + 200]
+        p = psnr(out, oracle)
+        assert p >= 30.0, f"crop PSNR {p:.1f} dB < 30"
+
+    def test_extract_exact(self):
+        arr = _img(300, 400, seed=4)
+        out = _run(
+            "extract",
+            ImageOptions(top=40, left=60, area_width=180, area_height=90),
+            arr,
+        )
+        np.testing.assert_array_equal(out, arr[40:130, 60:240])
+
+    def test_flip_flop_exact(self):
+        arr = _img(120, 90, seed=5)
+        np.testing.assert_array_equal(_run("flip", ImageOptions(), arr), arr[::-1])
+        np.testing.assert_array_equal(_run("flop", ImageOptions(), arr), arr[:, ::-1])
+
+    @pytest.mark.parametrize("angle,k", [(90, -1), (180, 2), (270, 1)])
+    def test_rot90_exact(self, angle, k):
+        arr = _img(120, 90, seed=6)
+        out = _run("rotate", ImageOptions(rotate=angle), arr)
+        # bimg rotation is clockwise; np.rot90 is counter-clockwise
+        np.testing.assert_array_equal(out, np.rot90(arr, k=k))
+
+
+class TestBlurPSNR:
+    def test_blur_vs_dense_float_conv(self):
+        arr = _img(128, 160, seed=7)
+        sigma = 2.0
+        out = _run("blur", ImageOptions(sigma=sigma), arr)
+
+        # independent float64 separable gaussian with edge clamp
+        radius = max(1, int(np.ceil(3.0 * sigma)))
+        xs = np.arange(-radius, radius + 1, dtype=np.float64)
+        k = np.exp(-0.5 * (xs / sigma) ** 2)
+        k /= k.sum()
+        x = arr.astype(np.float64)
+        pad = np.pad(x, ((radius, radius), (0, 0), (0, 0)), mode="edge")
+        x = sum(k[i] * pad[i : i + arr.shape[0]] for i in range(2 * radius + 1))
+        pad = np.pad(x, ((0, 0), (radius, radius), (0, 0)), mode="edge")
+        x = sum(k[i] * pad[:, i : i + arr.shape[1]] for i in range(2 * radius + 1))
+        oracle = np.clip(np.round(x), 0, 255).astype(np.uint8)
+
+        p = psnr(out, oracle)
+        assert p >= 35.0, f"blur PSNR {p:.1f} dB < 35"
+
+
+class TestSmartcropAgreement:
+    def test_window_covers_salient_region(self, testdata):
+        """The generated smart-crop fixture has one high-saliency disc; the
+        chosen 200x200 window must contain its centre (the agreement check
+        SURVEY section 7 hard-part 4 asks for, with the fixture's known
+        ground truth standing in for libvips attention)."""
+        import os
+
+        from imaginary_tpu import codecs
+        from tests.gen_fixtures import generate_all
+
+        path = os.path.join(testdata, "smart-crop.jpg")
+        if not os.path.exists(path):
+            generate_all(testdata)
+        with open(path, "rb") as f:
+            buf = f.read()
+        d = codecs.decode(buf)
+        arr = d.array
+
+        # ground truth: the fixture's salient disc is the red-dominant blob
+        def red_dom(a):
+            r = a[:, :, 0].astype(np.int32)
+            g = a[:, :, 1].astype(np.int32)
+            b = a[:, :, 2].astype(np.int32)
+            return np.clip(r - (g + b) // 2, 0, 255)
+
+        src_salient = int((red_dom(arr) > 100).sum())
+        assert src_salient > 0, "fixture has no salient region?"
+
+        out = _run("smartcrop", ImageOptions(width=200, height=200), arr)
+        assert out.shape[:2] == (200, 200)
+        # smartcrop resizes-to-fill first (scale = cover factor), so the
+        # disc's pixel count in the output shrinks by scale^2; demand >= 60%
+        # of the scaled disc inside the chosen window
+        h, w = arr.shape[:2]
+        scale = max(200 / w, 200 / h)
+        expected = src_salient * scale * scale
+        captured = int((red_dom(out) > 100).sum())
+        assert captured >= 0.6 * expected, (captured, expected)
