@@ -28,7 +28,7 @@ RUN pip install --no-cache-dir jax flax optax einops numpy pillow pytest \
     opencv-python-headless aiohttp
 COPY tests/ tests/
 COPY conftest.py* ./
-RUN JAX_PLATFORMS=cpu python -m pytest tests/ -x -q
+RUN JAX_PLATFORMS=cpu python -m pytest tests/ -x -q && touch /tests-passed
 
 # ---- runtime ---------------------------------------------------------------
 FROM python:3.12-slim-bookworm
@@ -50,12 +50,18 @@ RUN pip install --no-cache-dir jax flax optax einops numpy pillow \
 WORKDIR /app
 COPY imaginary_tpu/ imaginary_tpu/
 COPY --from=build /src/imaginary_tpu/native/_imaginary_codecs*.so imaginary_tpu/native/
+# depending on the test stage forces `docker build` to actually run it
+# (BuildKit prunes stages the final image doesn't reference)
+COPY --from=test /tests-passed /tmp/tests-passed
 
 # Long-lived glibc processes fragment under per-request allocation churn;
 # capping arenas is the stock mitigation (the reference LD_PRELOADs jemalloc
 # for the same reason, and documents MALLOC_ARENA_MAX=2 — README.md:235).
+# HOME=/tmp: the XLA persistent compile cache lives under ~/.cache and the
+# runtime user `nobody` has no real home directory.
 ENV MALLOC_ARENA_MAX=2 \
     PYTHONUNBUFFERED=1 \
+    HOME=/tmp \
     PORT=9000
 
 EXPOSE 9000

@@ -72,7 +72,7 @@ def bench_ours(buf: bytes, n_threads: int, duration: float):
         # same per-request work the service does: header probe -> provably
         # output-preserving shrink-on-load -> plan -> micro-batched device
         # chain -> encode
-        meta = codecs.probe(buf)
+        meta = codecs.probe_fast(buf)
         shrink = choose_decode_shrink("resize", opts, meta.height, meta.width,
                                       meta.orientation, 3)
         d = codecs.decode(buf, shrink)
@@ -83,7 +83,7 @@ def bench_ours(buf: bytes, n_threads: int, duration: float):
 
     # warmup: compile every batch size the power-of-two padding can produce,
     # so no XLA compile lands inside the timed window
-    meta0 = codecs.probe(buf)
+    meta0 = codecs.probe_fast(buf)
     d0 = codecs.decode(buf, choose_decode_shrink("resize", opts, meta0.height,
                                                  meta0.width, meta0.orientation, 3))
     plan0 = plan_operation("resize", opts, d0.array.shape[0], d0.array.shape[1],

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Device-path microbenchmark: where does on-chip time go, and does the
+Pallas fused-resample beat the einsum path? (VERDICT r1 next #1/#3.)
+
+Per bucket (1080p full, 1080p-shrunk, 4K) and per batch size this measures,
+with warm compile caches:
+
+  h2d_ms       host->device transfer of the uint8 input batch
+  compute_ms   jitted chain execution, inputs already on device
+  d2h_ms       device->host readback of the uint8 output
+  e2e_ms       launch_batch + fetch (the executor's actual cost)
+  imgs_per_s   per-chip throughput at that batch size (compute only)
+  tflops/mfu   achieved matmul throughput of the resample einsums, vs the
+               chip's bf16 peak (PEAK_TFLOPS env, default 197 = v5e)
+
+plus an einsum-vs-Pallas A/B on the same chain when the backend is TPU.
+
+Usage: python bench_device.py            (probes the accelerator; refuses
+                                          to silently substitute CPU)
+       BENCH_PLATFORM=cpu python bench_device.py   (explicit CPU run)
+
+One JSON line per measurement on stdout; human detail on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPS = int(os.environ.get("BENCH_REPS", "10"))
+PEAK_TFLOPS = float(os.environ.get("PEAK_TFLOPS", "197"))  # v5e bf16 peak
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _probe_accelerator(timeout: float = 90.0) -> bool:
+    import subprocess
+
+    code = ("import jax; jax.devices(); import jax.numpy as jnp; "
+            "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _med(xs):
+    return sorted(xs)[len(xs) // 2]
+
+
+def resample_flops(in_h, in_w, out_h, out_w, c=3):
+    """FLOPs of the separable resample's two contractions per image."""
+    return 2 * out_h * in_h * in_w * c + 2 * out_w * in_w * out_h * c
+
+
+def bench_chain(name, in_h, in_w, out_h, out_w, batches=(1, 8, 16, 32, 64)):
+    import jax
+
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops import chain as chain_mod
+    from imaginary_tpu.ops.buckets import bucket_shape
+    from imaginary_tpu.ops.plan import plan_operation
+
+    rng = np.random.default_rng(0)
+    opts = ImageOptions(width=out_w, height=out_h, force=True)
+    plan = plan_operation("resize", opts, in_h, in_w, 0, 3)
+    hb, wb = bucket_shape(in_h, in_w)
+    flops = resample_flops(in_h, in_w, out_h, out_w)
+    results = []
+    for bs in batches:
+        arrs = [rng.integers(0, 256, (in_h, in_w, 3), dtype=np.uint8)
+                for _ in range(bs)]
+        plans = [plan] * bs
+
+        # e2e: exactly what the executor pays (async launch, then fetch)
+        y = chain_mod.launch_batch(arrs, plans)
+        chain_mod.fetch_batch(y, arrs, plans)  # compile warmup
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            y = chain_mod.launch_batch(arrs, plans)
+            chain_mod.fetch_batch(y, arrs, plans)
+            ts.append((time.perf_counter() - t0) * 1000)
+        e2e = _med(ts)
+
+        # split: H2D / compute / D2H with pre-staged input
+        batch_np = np.stack([chain_mod.pad_to_bucket(a) for a in arrs])
+        ts_h2d, ts_cmp, ts_d2h = [], [], []
+        import jax.numpy as jnp
+
+        h = jnp.asarray(np.full((bs,), in_h, np.int32))
+        w = jnp.asarray(np.full((bs,), in_w, np.int32))
+        dyns = chain_mod._stack_dyns(plans)
+        specs = plan.spec_key()
+        fn = jax.jit(chain_mod._run_chain, static_argnums=0)
+        xd = jax.device_put(batch_np)
+        yd, _, _ = fn(specs, xd, h, w, dyns)
+        yd.block_until_ready()  # warm
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            xd = jax.device_put(batch_np)
+            xd.block_until_ready()
+            t1 = time.perf_counter()
+            yd, _, _ = fn(specs, xd, h, w, dyns)
+            yd.block_until_ready()
+            t2 = time.perf_counter()
+            host = jax.device_get(yd)
+            t3 = time.perf_counter()
+            ts_h2d.append((t1 - t0) * 1000)
+            ts_cmp.append((t2 - t1) * 1000)
+            ts_d2h.append((t3 - t2) * 1000)
+        cmp_ms = _med(ts_cmp)
+        achieved = flops * bs / (cmp_ms / 1000) / 1e12 if cmp_ms > 0 else 0
+        row = {
+            "metric": f"device_chain_{name}",
+            "batch": bs,
+            "bucket": [hb, wb],
+            "e2e_ms": round(e2e, 3),
+            "h2d_ms": round(_med(ts_h2d), 3),
+            "compute_ms": round(cmp_ms, 3),
+            "d2h_ms": round(_med(ts_d2h), 3),
+            "e2e_ms_per_img": round(e2e / bs, 3),
+            "imgs_per_s_compute": round(bs / (cmp_ms / 1000), 1),
+            "achieved_tflops": round(achieved, 3),
+            "mfu_vs_bf16_peak": round(achieved / PEAK_TFLOPS, 4),
+        }
+        results.append(row)
+        log(f"[dev] {name} bs={bs}: e2e={e2e:.1f}ms "
+            f"(h2d={row['h2d_ms']} cmp={row['compute_ms']} d2h={row['d2h_ms']}) "
+            f"{row['imgs_per_s_compute']} imgs/s {row['achieved_tflops']} TF")
+        print(json.dumps(row), flush=True)
+    return results
+
+
+def bench_pallas_ab(in_h, in_w, out_h, out_w, bs=16):
+    """Same resample through the einsum chain vs the fused Pallas kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from imaginary_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 256, (bs, in_h, in_w, 3)).astype(np.float32))
+    src_h = jnp.full((bs,), float(in_h))
+    dst_h = jnp.full((bs,), float(out_h))
+    src_w = jnp.full((bs,), float(in_w))
+    dst_w = jnp.full((bs,), float(out_w))
+
+    on_tpu = jax.default_backend() == "tpu"
+    y = pk.resample_2d(x, src_h, dst_h, src_w, dst_w, out_h, out_w,
+                       interpret=not on_tpu)
+    y.block_until_ready()
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        y = pk.resample_2d(x, src_h, dst_h, src_w, dst_w, out_h, out_w,
+                           interpret=not on_tpu)
+        y.block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1000)
+    pallas_ms = _med(ts)
+
+    # einsum equivalent (the stages.py path): batched sampling matrices
+    def einsum_resample(x, src_h, dst_h, src_w, dst_w):
+        def weights(out_size, in_size, src, dst):
+            y = jnp.arange(out_size, dtype=jnp.float32)[None, :, None]
+            k = jnp.arange(in_size, dtype=jnp.float32)[None, None, :]
+            scale = dst[:, None, None] / src[:, None, None]
+            centre = (y + 0.5) / scale - 0.5
+            stretch = jnp.maximum(1.0, 1.0 / scale)
+            d = (k - centre) / stretch
+            w = jnp.where(jnp.abs(d) < 3.0, jnp.sinc(d) * jnp.sinc(d / 3.0), 0.0)
+            w = jnp.where((k < src[:, None, None]) & (y < dst[:, None, None]), w, 0.0)
+            n = jnp.sum(w, axis=-1, keepdims=True)
+            return jnp.where(n > 1e-6, w / jnp.maximum(n, 1e-6), 0.0)
+
+        wh = weights(out_h, x.shape[1], src_h, dst_h)
+        t = jnp.einsum("boi,bihc->bohc", wh, x)
+        ww = weights(out_w, x.shape[2], src_w, dst_w)
+        return jnp.einsum("boi,bhic->bhoc", ww, t)
+
+    f = jax.jit(einsum_resample)
+    y2 = f(x, src_h, dst_h, src_w, dst_w)
+    y2.block_until_ready()
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        y2 = f(x, src_h, dst_h, src_w, dst_w)
+        y2.block_until_ready()
+        ts.append((time.perf_counter() - t0) * 1000)
+    einsum_ms = _med(ts)
+
+    err = float(jnp.max(jnp.abs(y - y2)))
+    flops = resample_flops(in_h, in_w, out_h, out_w) * bs
+    row = {
+        "metric": f"pallas_vs_einsum_{in_h}x{in_w}to{out_h}x{out_w}",
+        "batch": bs,
+        "backend": jax.default_backend(),
+        "pallas_interpret": not on_tpu,
+        "pallas_ms": round(pallas_ms, 3),
+        "einsum_ms": round(einsum_ms, 3),
+        "speedup": round(einsum_ms / pallas_ms, 3) if pallas_ms > 0 else 0,
+        "max_abs_err": round(err, 4),
+        "pallas_tflops": round(flops / (pallas_ms / 1000) / 1e12, 3),
+        "einsum_tflops": round(flops / (einsum_ms / 1000) / 1e12, 3),
+    }
+    log(f"[dev] pallas A/B {row['metric']}: pallas={pallas_ms:.2f}ms "
+        f"einsum={einsum_ms:.2f}ms speedup={row['speedup']}x err={err:.3f}")
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    if not platform:
+        if not _probe_accelerator():
+            log("[dev] *** ACCELERATOR UNREACHABLE — refusing to run; set "
+                "BENCH_PLATFORM=cpu for an explicit CPU run ***")
+            print(json.dumps({"metric": "device_bench", "error": "accelerator unreachable"}))
+            return 1
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    import jax
+
+    log(f"[dev] backend={jax.default_backend()} devices={len(jax.devices())} "
+        f"reps={REPS}")
+
+    if os.environ.get("BENCH_SMALL") == "1":
+        # quick CPU smoke: tiny shapes only (full buckets take minutes/rep
+        # on a 1-CPU host; the real run happens on the chip)
+        bench_chain("smoke", 128, 160, 64, 80, batches=(1, 8))
+        bench_pallas_ab(128, 160, 64, 80, bs=2)
+        return 0
+
+    # the three serving buckets: full 1080p, its 1/4 shrink, 4K
+    bench_chain("1080p", 1080, 1920, 200, 300)
+    bench_chain("1080p_shrink4", 270, 480, 200, 300, batches=(1, 16, 64))
+    bench_chain("4k", 2160, 3840, 480, 854, batches=(1, 8, 16))
+
+    # Pallas A/B at the shrink bucket (the real serving shape) and full
+    bench_pallas_ab(270, 480, 200, 300)
+    bench_pallas_ab(1080, 1920, 200, 300, bs=4)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,25 @@ class EncodeOptions:
         return max(0, min(c, 9))
 
 
+# Formats handled by host-native loaders (ctypes, vector_backend) rather
+# than the raster codec backends.
+SPECIAL_TYPES = frozenset(
+    {ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF}
+)
+
+
+def _pil_open_rgba(buf: bytes):
+    """(array, has_alpha) via PIL — shared by HEIF/AVIF decode and probe."""
+    from io import BytesIO
+
+    from PIL import Image
+
+    with Image.open(BytesIO(buf)) as im:
+        has_alpha = im.mode in ("RGBA", "LA", "PA")
+        arr = np.asarray(im.convert("RGBA" if has_alpha else "RGB"))
+    return arr, has_alpha
+
+
 class CodecError(ImageError):
     def __init__(self, message: str, code: int = 400):
         super().__init__(message, code)
@@ -134,7 +153,7 @@ def decode(buf: bytes, shrink: int = 1) -> DecodedImage:
     if not buf:
         raise CodecError("Empty or unreadable image", 400)
     t = determine_image_type(buf)
-    if t in (ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF):
+    if t in SPECIAL_TYPES:
         return _decode_special(buf, t, shrink)
     return _backend().decode(buf, t, shrink)
 
@@ -160,13 +179,7 @@ def _decode_special(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
             return DecodedImage(array=arr, type=t, orientation=0, has_alpha=False)
         if t is ImageType.AVIF:
             try:  # PIL's avif plugin when compiled in, else libheif
-                from io import BytesIO
-
-                from PIL import Image
-
-                with Image.open(BytesIO(buf)) as im:
-                    has_alpha = im.mode in ("RGBA", "LA", "PA")
-                    arr = np.asarray(im.convert("RGBA" if has_alpha else "RGB"))
+                arr, has_alpha = _pil_open_rgba(buf)
                 return DecodedImage(array=arr, type=t, orientation=0, has_alpha=has_alpha)
             except Exception:
                 if vb.heif_available():
@@ -205,11 +218,26 @@ def probe(buf: bytes) -> ImageMetadata:
     if not buf:
         raise CodecError("Cannot retrieve image metadata: empty buffer", 400)
     t = determine_image_type(buf)
-    if t in (ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF):
+    if t in SPECIAL_TYPES:
         m = _probe_special(buf, t)
         if m is not None:
             return m
     return _backend().probe(buf, t)
+
+
+def probe_fast(buf: bytes) -> ImageMetadata:
+    """Dims/orientation-only probe for the request hot path (shrink-on-load
+    selection). Prefers the backend's GIL-free header parser when it has
+    one; metadata richness (space, ICC) is NOT guaranteed — use probe()
+    for /info."""
+    if not buf:
+        raise CodecError("Cannot retrieve image metadata: empty buffer", 400)
+    t = determine_image_type(buf)
+    b = _backend()
+    fast = getattr(b, "probe_fast", None)
+    if fast is not None and t not in SPECIAL_TYPES:
+        return fast(buf, t)
+    return probe(buf)
 
 
 def _probe_special(buf: bytes, t: ImageType) -> Optional[ImageMetadata]:

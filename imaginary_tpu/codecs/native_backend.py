@@ -71,7 +71,8 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
 
 def probe(buf: bytes, t: ImageType) -> ImageMetadata:
     # PIL's probe is header-only (no pixel decode) and carries richer
-    # metadata (colour space, ICC flag); the native probe is the fallback.
+    # metadata (colour space, ICC flag) — it serves /info; the native probe
+    # is the fallback here and the PRIMARY for probe_fast below.
     from imaginary_tpu.codecs import pil_backend
 
     if t not in _NATIVE_TYPES:
@@ -80,6 +81,10 @@ def probe(buf: bytes, t: ImageType) -> ImageMetadata:
         return pil_backend.probe(buf, t)
     except CodecError:
         pass
+    return _native_probe(buf, t)
+
+
+def _native_probe(buf: bytes, t: ImageType) -> ImageMetadata:
     try:
         w, h, c, has_alpha, orientation = _ext.probe(buf, t.value)
     except Exception as e:
@@ -89,3 +94,18 @@ def probe(buf: bytes, t: ImageType) -> ImageMetadata:
         has_alpha=bool(has_alpha), has_profile=False,
         channels=c, orientation=orientation,
     )
+
+
+def probe_fast(buf: bytes, t: ImageType) -> ImageMetadata:
+    """Dims/orientation-only probe on the request hot path (shrink-on-load
+    selection needs nothing else). The C++ header parser runs with the GIL
+    released and skips PIL's lazy-open machinery; PIL remains the fallback
+    and the rich /info probe."""
+    if t in _NATIVE_TYPES:
+        try:
+            return _native_probe(buf, t)
+        except CodecError:
+            pass
+    from imaginary_tpu.codecs import pil_backend
+
+    return pil_backend.probe(buf, t)

@@ -140,8 +140,17 @@ def _svg_handle(buf: bytes):
     return h
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=256)
 def svg_intrinsic_size(buf: bytes) -> tuple:
-    """(width, height) in px; falls back to the legacy dimensions API."""
+    """(width, height) in px; falls back to the legacy dimensions API.
+
+    LRU-cached: a request probes the size (shrink selection, /info) and then
+    rasterizes — caching collapses the probe parses so each distinct SVG
+    pays one size parse ever, leaving only the (unavoidable) render parse
+    inside rasterize_svg."""
     with _lock:
         h = _svg_handle(buf)
         try:

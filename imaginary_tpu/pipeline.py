@@ -162,7 +162,7 @@ def _pick_shrink(name: str, buf: bytes, o: ImageOptions) -> int:
     if determine_image_type(buf) not in (ImageType.JPEG, ImageType.SVG):
         return 1
     try:
-        meta = codecs.probe(buf)
+        meta = codecs.probe_fast(buf)
         return choose_decode_shrink(name, o, meta.height, meta.width,
                                     meta.orientation, max(3, meta.channels))
     except ImageError:
@@ -186,7 +186,19 @@ def process_pipeline(
     if len(o.operations) > MAX_PIPELINE_OPERATIONS:
         raise new_error(f"Maximum pipeline operations ({MAX_PIPELINE_OPERATIONS}) exceeded", 400)
 
-    d = codecs.decode(buf)
+    # Shrink-on-load keyed to the FIRST op: its planner proof guarantees the
+    # op's output dims are unchanged at 1/N decode, and every later op sees
+    # only that output — so the whole pipeline's geometry is preserved while
+    # the decode (and the first device stage) touch up to 64x fewer pixels.
+    shrink = 1
+    first = o.operations[0]
+    if first.name in OPERATION_NAMES:
+        try:
+            shrink = _pick_shrink(first.name, buf, build_params_from_operation(first))
+        except Exception:
+            shrink = 1
+
+    d = codecs.decode(buf, shrink)
     cur_h, cur_w = d.array.shape[0], d.array.shape[1]
     orientation = d.orientation
     channels = d.array.shape[2]

@@ -29,12 +29,14 @@ def enable_persistent_cache(path: str = "") -> str:
     path = path or os.environ.get(
         "IMAGINARY_TPU_CACHE", os.path.expanduser("~/.cache/imaginary_tpu/xla")
     )
-    os.makedirs(path, exist_ok=True)
     try:
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
-        pass
+        # unwritable home (container USER nobody, read-only fs): serve
+        # without a persistent cache rather than dying before bind
+        return ""
     return path
 
 
