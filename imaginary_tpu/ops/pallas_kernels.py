@@ -69,17 +69,43 @@ def _row_tile(out_size: int) -> int:
     return out_size
 
 
+# VMEM is ~16 MB/core (pallas_guide.md); budget the image block well under
+# that so weights + output + double-buffering fit. A full-row block of a
+# 1080p bucket (1088 x 5760 f32 = 25 MB) does NOT fit — the W axis must be
+# tiled too.
+_VMEM_BLOCK_BUDGET = 4 * 1024 * 1024
+
+
+def _col_tile(wc: int, in_h: int) -> int:
+    """Largest divisor of wc whose [in_h, tile] f32 block fits the budget,
+    preferring lane-aligned (multiple-of-128) tiles for MXU efficiency."""
+    cap = max(128, _VMEM_BLOCK_BUDGET // (in_h * 4))
+    best = None
+    for t in range(1, wc + 1):
+        if wc % t == 0 and t <= cap:
+            if t % 128 == 0:
+                best = t  # keep the largest lane-aligned divisor
+            elif best is None or best % 128 != 0:
+                best = max(best or 0, t)
+    return best or wc
+
+
 @functools.partial(jax.jit, static_argnames=("out_size", "kind", "interpret"))
 def resample_rows(x, src, dst, out_size: int, kind: str = "lanczos3",
                   interpret: bool = False):
     """Resample axis 1: [B, in_h, W, C] f32 -> [B, out_size, W, C].
 
-    src/dst: [B] f32 valid sizes (dynamic). Fused weights-in-VMEM matmul.
+    src/dst: [B] f32 valid sizes (dynamic). Fused weights-in-VMEM matmul:
+    the [tile, in_h] weight block is generated in VMEM per grid step and
+    immediately contracted on the MXU — HBM never sees a weight matrix.
+    Grid = (batch, row tiles, width tiles); the width tiling keeps every
+    VMEM block within budget for arbitrarily large buckets (4K included).
     """
     b, in_h, width, ch = x.shape
     wc = width * ch
     x2 = x.reshape(b, in_h, wc)
     tile = _row_tile(out_size)
+    wtile = _col_tile(wc, in_h)
 
     def kernel(src_ref, dst_ref, x_ref, o_ref):
         bi = pl.program_id(0)
@@ -92,13 +118,13 @@ def resample_rows(x, src, dst, out_size: int, kind: str = "lanczos3",
 
     out = pl.pallas_call(
         kernel,
-        grid=(b, out_size // tile),
+        grid=(b, out_size // tile, wc // wtile),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, in_h, wc), lambda bi, ti: (bi, 0, 0)),
+            pl.BlockSpec((1, in_h, wtile), lambda bi, ti, wi: (bi, 0, wi)),
         ],
-        out_specs=pl.BlockSpec((1, tile, wc), lambda bi, ti: (bi, ti, 0)),
+        out_specs=pl.BlockSpec((1, tile, wtile), lambda bi, ti, wi: (bi, ti, wi)),
         out_shape=jax.ShapeDtypeStruct((b, out_size, wc), jnp.float32),
         interpret=interpret,
     )(src, dst, x2)
