@@ -5,8 +5,10 @@ The einsum resample (stages.py) materializes per-batch sampling matrices
 into the matmul: for each output row tile, the [TILE, in] weight block is
 computed in VMEM from the dynamic (src, dst) sizes and immediately
 contracted against the image block on the MXU — HBM never sees a weight
-matrix. (See /opt/skills/guides/pallas_guide.md; grid over (batch, row
-tiles), scalar sizes in SMEM.)
+matrix. (See /opt/skills/guides/pallas_guide.md; grid over (batch, width
+tiles, row tiles) — row tiles innermost so the input block index is constant
+across the inner axis and each image row-band is DMA'd from HBM once; scalar
+sizes in SMEM.)
 
 Opt-in via IMAGINARY_TPU_PALLAS=1 (stages.SampleSpec consults
 `use_pallas()`); interpret mode keeps it testable on CPU.
@@ -62,32 +64,30 @@ def _weights_block(y0, tile, in_size, src, dst, kind: str):
     return jnp.where(norm > _EPS, wts / jnp.maximum(norm, _EPS), 0.0)
 
 
-def _row_tile(out_size: int) -> int:
-    for t in (256, 128, 64, 32, 16, 8):
-        if out_size % t == 0:
-            return t
-    return out_size
-
-
-# VMEM is ~16 MB/core (pallas_guide.md); budget the image block well under
-# that so weights + output + double-buffering fit. A full-row block of a
+# VMEM is ~16 MB/core (pallas_guide.md); budget each block well under that
+# so x + weights + output + double-buffering fit. A full-row block of a
 # 1080p bucket (1088 x 5760 f32 = 25 MB) does NOT fit — the W axis must be
-# tiled too.
+# tiled too, and the [tile, in_h] weight block must shrink as in_h grows.
 _VMEM_BLOCK_BUDGET = 4 * 1024 * 1024
+
+
+def _row_tile(out_size: int, in_h: int) -> int:
+    """Largest divisor of out_size (<= 256) whose [tile, in_h] f32 weight
+    block fits the budget (very tall sources shrink the tile instead of
+    blowing VMEM)."""
+    cap = min(256, max(1, _VMEM_BLOCK_BUDGET // (in_h * 4)))
+    return max(t for t in range(1, out_size + 1) if out_size % t == 0 and t <= cap)
 
 
 def _col_tile(wc: int, in_h: int) -> int:
     """Largest divisor of wc whose [in_h, tile] f32 block fits the budget,
     preferring lane-aligned (multiple-of-128) tiles for MXU efficiency."""
-    cap = max(128, _VMEM_BLOCK_BUDGET // (in_h * 4))
-    best = None
-    for t in range(1, wc + 1):
-        if wc % t == 0 and t <= cap:
-            if t % 128 == 0:
-                best = t  # keep the largest lane-aligned divisor
-            elif best is None or best % 128 != 0:
-                best = max(best or 0, t)
-    return best or wc
+    cap = _VMEM_BLOCK_BUDGET // (in_h * 4)
+    divisors = [t for t in range(1, wc + 1) if wc % t == 0 and t <= cap]
+    if not divisors:
+        return 1
+    aligned = [t for t in divisors if t % 128 == 0]
+    return max(aligned) if aligned else max(divisors)
 
 
 @functools.partial(jax.jit, static_argnames=("out_size", "kind", "interpret"))
@@ -104,27 +104,31 @@ def resample_rows(x, src, dst, out_size: int, kind: str = "lanczos3",
     b, in_h, width, ch = x.shape
     wc = width * ch
     x2 = x.reshape(b, in_h, wc)
-    tile = _row_tile(out_size)
+    tile = _row_tile(out_size, in_h)
     wtile = _col_tile(wc, in_h)
 
     def kernel(src_ref, dst_ref, x_ref, o_ref):
         bi = pl.program_id(0)
-        ti = pl.program_id(1)
+        ti = pl.program_id(2)
         wts = _weights_block(
             (ti * tile).astype(jnp.float32), tile, in_h,
             src_ref[bi], dst_ref[bi], kind,
         )
         o_ref[0] = jnp.dot(wts, x_ref[0], preferred_element_type=jnp.float32)
 
+    # Row tiles are the INNER grid axis: the x block index (bi, 0, wi) is
+    # then constant across the inner loop, so Pallas skips the re-DMA and
+    # each image column-band is fetched from HBM once. The [tile, in_h]
+    # weight block is regenerated per step — cheap VPU work vs HBM traffic.
     out = pl.pallas_call(
         kernel,
-        grid=(b, out_size // tile, wc // wtile),
+        grid=(b, wc // wtile, out_size // tile),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, in_h, wtile), lambda bi, ti, wi: (bi, 0, wi)),
+            pl.BlockSpec((1, in_h, wtile), lambda bi, wi, ti: (bi, 0, wi)),
         ],
-        out_specs=pl.BlockSpec((1, tile, wtile), lambda bi, ti, wi: (bi, ti, wi)),
+        out_specs=pl.BlockSpec((1, tile, wtile), lambda bi, wi, ti: (bi, ti, wi)),
         out_shape=jax.ShapeDtypeStruct((b, out_size, wc), jnp.float32),
         interpret=interpret,
     )(src, dst, x2)
