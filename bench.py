@@ -93,13 +93,20 @@ def bench_ours(buf: bytes, n_threads: int, duration: float):
         for f in futs:
             f.result(timeout=300)
     print(f"[bench] warmup done, backend={codecs.backend_name()}", file=sys.stderr)
+    from imaginary_tpu.engine.timing import maybe_start_profiler, stop_profiler
+
+    profiling = maybe_start_profiler()  # IMAGINARY_TPU_PROFILE_DIR=<dir>
     TIMES.reset()
     # stats must cover ONLY the timed window (warmup items would inflate
     # the device-vs-spill split the JSON reports)
     from imaginary_tpu.engine.executor import ExecutorStats
 
     executor.stats = ExecutorStats()
-    rate, lats = _run_threaded(one, n_threads, duration)
+    try:
+        rate, lats = _run_threaded(one, n_threads, duration)
+    finally:
+        if profiling:
+            stop_profiler()  # flush the trace even when the run errors
     stats = executor.stats.to_dict()
     stages = TIMES.snapshot()
     executor.shutdown()

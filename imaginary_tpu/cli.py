@@ -180,6 +180,16 @@ def main(argv=None) -> int:
     from imaginary_tpu.prewarm import enable_persistent_cache
 
     enable_persistent_cache()
+
+    # IMAGINARY_TPU_PROFILE_DIR=<dir> captures a jax.profiler trace of the
+    # serving loop for TensorBoard/xprof (SURVEY.md section 5.1)
+    from imaginary_tpu.engine.timing import maybe_start_profiler, stop_profiler
+
+    if maybe_start_profiler():
+        import atexit
+
+        atexit.register(stop_profiler)
+
     from imaginary_tpu.web.app import serve
 
     if o.prewarm:

@@ -7,8 +7,8 @@ computed in VMEM from the dynamic (src, dst) sizes and immediately
 contracted against the image block on the MXU — HBM never sees a weight
 matrix. (See /opt/skills/guides/pallas_guide.md; grid over (batch, width
 tiles, row tiles) — row tiles innermost so the input block index is constant
-across the inner axis and each image row-band is DMA'd from HBM once; scalar
-sizes in SMEM.)
+across the inner axis and each image column-band [in_h, wtile] is DMA'd from
+HBM once; scalar sizes in SMEM.)
 
 Opt-in via IMAGINARY_TPU_PALLAS=1 (stages.SampleSpec consults
 `use_pallas()`); interpret mode keeps it testable on CPU.
@@ -27,13 +27,25 @@ from jax.experimental.pallas import tpu as pltpu
 _EPS = 1e-6
 
 
+# Default once hardware A/B numbers exist (bench_device.py pallas_vs_einsum):
+# flip to True when the fused kernel beats the einsum path on the serving
+# buckets. Env always wins: IMAGINARY_TPU_PALLAS=1 forces on, =0 forces off.
+_AUTO_DEFAULT = False
+
+
 def use_pallas() -> bool:
-    if os.environ.get("IMAGINARY_TPU_PALLAS", "") != "1":
-        return False
+    env = os.environ.get("IMAGINARY_TPU_PALLAS", "").strip().lower()
     try:
-        return jax.default_backend() == "tpu"
+        on_tpu = jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         return False
+    if env in ("1", "true", "on", "yes"):
+        return on_tpu
+    if env == "":
+        return _AUTO_DEFAULT and on_tpu
+    # any other value ("0", "off", "false", typos) is an explicit disable —
+    # an opt-out must never silently fall through to auto
+    return False
 
 
 def _weights_block(y0, tile, in_size, src, dst, kind: str):
@@ -71,11 +83,11 @@ def _weights_block(y0, tile, in_size, src, dst, kind: str):
 _VMEM_BLOCK_BUDGET = 4 * 1024 * 1024
 
 
-def _row_tile(out_size: int, in_h: int) -> int:
-    """Largest divisor of out_size (<= 256) whose [tile, in_h] f32 weight
-    block fits the budget (very tall sources shrink the tile instead of
-    blowing VMEM)."""
-    cap = min(256, max(1, _VMEM_BLOCK_BUDGET // (in_h * 4)))
+def _row_tile(out_size: int, in_h: int, wtile: int) -> int:
+    """Largest divisor of out_size (<= 256) whose [tile, in_h] weight block
+    AND [tile, wtile] output block both fit the budget (tall sources and
+    wide outputs shrink the tile instead of blowing VMEM)."""
+    cap = min(256, max(1, _VMEM_BLOCK_BUDGET // (4 * max(in_h, wtile))))
     return max(t for t in range(1, out_size + 1) if out_size % t == 0 and t <= cap)
 
 
@@ -98,14 +110,15 @@ def resample_rows(x, src, dst, out_size: int, kind: str = "lanczos3",
     src/dst: [B] f32 valid sizes (dynamic). Fused weights-in-VMEM matmul:
     the [tile, in_h] weight block is generated in VMEM per grid step and
     immediately contracted on the MXU — HBM never sees a weight matrix.
-    Grid = (batch, row tiles, width tiles); the width tiling keeps every
-    VMEM block within budget for arbitrarily large buckets (4K included).
+    Grid = (batch, width tiles, row tiles) — row tiles innermost; the
+    width/row tiling keeps every VMEM block within budget for arbitrarily
+    large buckets (4K included).
     """
     b, in_h, width, ch = x.shape
     wc = width * ch
     x2 = x.reshape(b, in_h, wc)
-    tile = _row_tile(out_size, in_h)
     wtile = _col_tile(wc, in_h)
+    tile = _row_tile(out_size, in_h, wtile)
 
     def kernel(src_ref, dst_ref, x_ref, o_ref):
         bi = pl.program_id(0)
