@@ -114,3 +114,29 @@ class TestEndToEnd:
         # random-noise source is the worst case for DCT-scaled decode;
         # mean abs difference stays bounded
         assert float(np.mean(np.abs(a - b))) < 16.0
+
+
+def test_shrink_memo_matches_uncached():
+    """The memoized result must equal the uncached proof for a matrix of
+    shapes/opts (guards the fingerprint against missing a geometry field)."""
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops import plan as plan_mod
+
+    cases = [
+        ("resize", ImageOptions(width=300, height=200), 1080, 1920),
+        ("resize", ImageOptions(width=300), 550, 740),
+        ("thumbnail", ImageOptions(width=100), 1080, 1920),
+        ("crop", ImageOptions(width=400, height=300), 1080, 1920),
+        ("smartcrop", ImageOptions(width=200, height=200), 800, 600),
+        ("fit", ImageOptions(width=300, height=300), 550, 740),
+        ("resize", ImageOptions(width=1500), 1080, 1920),  # enlarge: no shrink
+    ]
+    plan_mod._SHRINK_MEMO.clear()
+    for name, o, h, w in cases:
+        got = plan_mod.choose_decode_shrink(name, o, h, w, 0, 3)
+        want = plan_mod._choose_decode_shrink_uncached(name, o, h, w, 0, 3)
+        assert got == want, (name, h, w, got, want)
+        # the call must actually have populated the memo...
+        assert plan_mod._SHRINK_MEMO, f"memo did not populate for {name}"
+        # ...and the memoized second call must agree
+        assert plan_mod.choose_decode_shrink(name, o, h, w, 0, 3) == want
