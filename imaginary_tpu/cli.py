@@ -156,6 +156,9 @@ def main(argv=None) -> int:
         return 0
     o = options_from_args(args)
 
+    if args.gzip:  # ref: imaginary.go:168-171
+        print("warning: -gzip flag is deprecated and will not have effect")
+
     # Pin the JAX platform when asked (e.g. IMAGINARY_TPU_PLATFORM=cpu for
     # dev boxes where the TPU plugin force-registers itself at boot and
     # overrides the standard JAX_PLATFORMS env var — re-pin it explicitly
