@@ -99,10 +99,13 @@ class SampleSpec:
                 self.out_hb, self.out_wb, self.kernel,
             )
         else:
+            mm = _mm_dtype()
             wy = sample_matrix(self.out_hb, x.shape[1], h.astype(jnp.float32), dyn["dst_h"], self.kernel)
-            t = jnp.einsum("byk,bkwc->bywc", wy, x)
+            t = jnp.einsum("byk,bkwc->bywc", wy.astype(mm), x.astype(mm),
+                           preferred_element_type=jnp.float32)
             wx = sample_matrix(self.out_wb, x.shape[2], w.astype(jnp.float32), dyn["dst_w"], self.kernel)
-            out = jnp.einsum("bxw,bywc->byxc", wx, t)
+            out = jnp.einsum("bxw,bywc->byxc", wx.astype(mm), t.astype(mm),
+                             preferred_element_type=jnp.float32)
         return out, dyn["dst_h"].astype(jnp.int32), dyn["dst_w"].astype(jnp.int32)
 
 
