@@ -3,7 +3,7 @@
 # loader libraries + an allocator tuned for a long-lived image service).
 #
 # Build:  docker build -t imaginary-tpu .
-# Run:    docker run -p 9000:9000 imaginary-tpu -enable-url-source
+# Run:    docker run -p 9000:9000 imaginary-tpu --enable-url-source
 #
 # TPU note: on a TPU VM run with the libtpu device mounted
 # (`--device /dev/accel0 --privileged` or the tpu-device-plugin on GKE) and

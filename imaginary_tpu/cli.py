@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
     p.add_argument("--spatial", type=int, default=1,
                    help="spatial mesh axis size (W-shard huge images across chips)")
+    p.add_argument("--host-spill", default="auto", choices=["auto", "on", "off"],
+                   help="spill to host SIMD when the device link saturates "
+                        "(auto = only with >=4 spare CPUs; spilled responses "
+                        "carry X-Imaginary-Backend: host)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
@@ -141,6 +145,7 @@ def options_from_args(args) -> ServerOptions:
         use_mesh=args.use_mesh,
         n_devices=args.devices or None,
         spatial=max(1, args.spatial),
+        host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         prewarm=args.prewarm,
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,

@@ -183,11 +183,11 @@ def _decode_special(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
                 return DecodedImage(array=arr, type=t, orientation=0, has_alpha=has_alpha)
             except Exception:
                 if vb.heif_available():
-                    arr = vb.decode_heif(buf)
-                    return DecodedImage(array=arr, type=t, orientation=0, has_alpha=True)
+                    arr, has_alpha = vb.decode_heif(buf)
+                    return DecodedImage(array=arr, type=t, orientation=0, has_alpha=has_alpha)
         if t is ImageType.HEIF and vb.heif_available():
-            arr = vb.decode_heif(buf)
-            return DecodedImage(array=arr, type=t, orientation=0, has_alpha=True)
+            arr, has_alpha = vb.decode_heif(buf)
+            return DecodedImage(array=arr, type=t, orientation=0, has_alpha=has_alpha)
     except CodecError:
         raise
     except Exception as e:

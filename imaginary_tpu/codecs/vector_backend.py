@@ -140,23 +140,35 @@ def _svg_handle(buf: bytes):
     return h
 
 
-import functools
+import hashlib
+
+# sha1(svg bytes) -> (w, h). Keyed on a 20-byte digest, NOT the payload:
+# an lru_cache on buf would pin up to 256 entire (multi-MB) request bodies
+# in memory with no size-based eviction. 28 bytes/entry here is negligible.
+_SVG_SIZE_CACHE: dict = {}
+_SVG_SIZE_CACHE_MAX = 4096
 
 
-@functools.lru_cache(maxsize=256)
 def svg_intrinsic_size(buf: bytes) -> tuple:
     """(width, height) in px; falls back to the legacy dimensions API.
 
-    LRU-cached: a request probes the size (shrink selection, /info) and then
-    rasterizes — caching collapses the probe parses so each distinct SVG
-    pays one size parse ever, leaving only the (unavoidable) render parse
-    inside rasterize_svg."""
+    Cached so a request that probes the size (shrink selection, /info) and
+    then rasterizes pays one size parse per distinct SVG, leaving only the
+    (unavoidable) render parse inside rasterize_svg."""
+    digest = hashlib.sha1(buf).digest()
+    hit = _SVG_SIZE_CACHE.get(digest)
+    if hit is not None:
+        return hit
     with _lock:
         h = _svg_handle(buf)
         try:
-            return _svg_size_from_handle(h)
+            size = _svg_size_from_handle(h)
         finally:
             _gobject.g_object_unref(ctypes.c_void_p(h))
+    if len(_SVG_SIZE_CACHE) >= _SVG_SIZE_CACHE_MAX:
+        _SVG_SIZE_CACHE.clear()  # rare full reset beats per-entry LRU links
+    _SVG_SIZE_CACHE[digest] = size
+    return size
 
 
 def _svg_size_from_handle(h) -> tuple:
@@ -293,8 +305,11 @@ def _setup_heif():
     _heif_ready = True
 
 
-def decode_heif(buf: bytes) -> np.ndarray:
-    """HEIF/AVIF bytes -> RGBA uint8 (libheif applies EXIF/irot/imir)."""
+def decode_heif(buf: bytes) -> tuple:
+    """HEIF/AVIF bytes -> (RGB/RGBA uint8, has_alpha); libheif applies
+    EXIF/irot/imir. Opaque sources drop the decoded alpha plane so the
+    decode path agrees with _probe_special's alpha flag (and PNG/WebP
+    re-encodes don't grow a spurious channel, matching libvips' loader)."""
     if not heif_available():
         raise RuntimeError("libheif not available on this host")
     _setup_heif()
@@ -324,7 +339,9 @@ def decode_heif(buf: bytes) -> np.ndarray:
         if not plane:
             raise ValueError("libheif: no interleaved plane")
         arr = np.ctypeslib.as_array(plane, shape=(ht, stride.value))
-        return arr[:, : w * 4].reshape(ht, w, 4).copy()
+        rgba = arr[:, : w * 4].reshape(ht, w, 4)
+        has_alpha = bool(h.heif_image_handle_has_alpha_channel(handle))
+        return (rgba.copy() if has_alpha else rgba[:, :, :3].copy()), has_alpha
     finally:
         if img:
             h.heif_image_release(img)

@@ -97,6 +97,23 @@ class ExecutorStats:
         }
 
 
+# Per-thread record of where the last submit()'s pixels were computed
+# ("device" | "host"). A request runs synchronously on one worker thread
+# (handler -> process_operation -> Executor.process), so the web layer can
+# read this after processing to emit X-Imaginary-Backend — operators need to
+# detect mixed-backend traffic because spilled pixels are PSNR-equivalent
+# but not bit-identical to device output.
+_PLACEMENT = threading.local()
+
+
+def reset_placement() -> None:
+    _PLACEMENT.value = None
+
+
+def last_placement() -> Optional[str]:
+    return getattr(_PLACEMENT, "value", None)
+
+
 class _Item:
     __slots__ = ("arr", "plan", "future", "key", "t")
 
@@ -174,6 +191,7 @@ class Executor:
         instead of queueing behind a drain the link can't keep up with.
         """
         item = _Item(arr, plan)
+        _PLACEMENT.value = "device"
         if not plan.stages:  # identity chain: no device work at all
             item.future.set_result(arr)
             return item.future
@@ -193,6 +211,7 @@ class Executor:
                     self._host_item_ms = 0.8 * self._host_item_ms + 0.2 * ms
                     self.stats.host_item_ms = self._host_item_ms
                 self.stats.spilled += 1
+                _PLACEMENT.value = "host"
                 item.future.set_result(out)
                 return item.future
         with self._owed_lock:

@@ -36,8 +36,26 @@ def _run_chain(specs, x, h, w, dyns):
     return x, h, w
 
 
-def _compiled(specs: tuple, in_shape: tuple, dyn_shapes_key: tuple):
-    key = (specs, in_shape, dyn_shapes_key)
+def _sharding_cache_key(sharding):
+    """Hashable descriptor of an input sharding. Part of the compile-cache
+    key so the FIRST launch of a (signature, sharding) pair registers as a
+    cache-size bump: the executor's cold-compile detector reads that bump,
+    and a resharded relaunch recompiles inside jax.jit — without this it
+    would be booked as a warm cost-model sample (ADVICE r2)."""
+    if sharding is None:
+        return None
+    try:
+        return (
+            tuple(sharding.mesh.axis_names),
+            tuple(sharding.mesh.devices.shape),
+            str(sharding.spec),
+        )
+    except AttributeError:  # non-Named shardings: coarse but safe
+        return repr(sharding)
+
+
+def _compiled(specs: tuple, in_shape: tuple, dyn_shapes_key: tuple, shard_key=None):
+    key = (specs, in_shape, dyn_shapes_key, shard_key)
     fn = _CACHE.get(key)
     if fn is None:
         with _LOCK:
@@ -116,7 +134,7 @@ def launch_batch(arrs: list, plans: list, sharding=None):
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
-    fn = _compiled(specs, batch.shape, dyn_key)
+    fn = _compiled(specs, batch.shape, dyn_key, _sharding_cache_key(sharding))
     y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
     return y
 

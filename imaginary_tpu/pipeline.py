@@ -122,17 +122,21 @@ def process_operation(
     o: ImageOptions,
     watermark_fetcher: Optional[WatermarkFetcher] = None,
     runner=None,
+    meta=None,
 ) -> ProcessedImage:
-    """Run one named operation end-to-end (decode -> device -> encode)."""
+    """Run one named operation end-to-end (decode -> device -> encode).
+
+    meta: an ImageMetadata the caller already probed (the web layer's
+    resolution guard), so the hot path parses headers exactly once."""
     if name == "info":
         return info(buf, o)
     if name == "pipeline":
-        return process_pipeline(buf, o, watermark_fetcher, runner=runner)
+        return process_pipeline(buf, o, watermark_fetcher, runner=runner, meta=meta)
     if name not in OPERATION_NAMES:
         raise new_error(f"Unsupported operation: {name}", 400)
 
     t_start = time.monotonic()
-    shrink = _pick_shrink(name, buf, o)
+    shrink = _pick_shrink(name, buf, o, meta)
     t_probe = time.monotonic()
     d = codecs.decode(buf, shrink)
     t_decode = time.monotonic()
@@ -149,20 +153,22 @@ def process_operation(
     return out
 
 
-def _pick_shrink(name: str, buf: bytes, o: ImageOptions) -> int:
+def _pick_shrink(name: str, buf: bytes, o: ImageOptions, meta=None) -> int:
     """JPEG shrink-on-load denominator for this request (1 = full decode).
 
     A header-only probe supplies source dims/orientation; the planner then
-    proves (by re-planning) that decoding at 1/N preserves the output. Pays
-    one extra header parse (~0.1 ms) to avoid decoding/moving up to 64x the
-    pixels the chain will immediately throw away. Applies to JPEG (DCT
-    scaling) and SVG (vector render straight into the 1/N box)."""
+    proves (by re-planning) that decoding at 1/N preserves the output —
+    avoiding decoding/moving up to 64x the pixels the chain will
+    immediately throw away. Applies to JPEG (DCT scaling) and SVG (vector
+    render straight into the 1/N box). The web layer passes its
+    resolution-guard probe as `meta` so no second header parse happens."""
     from imaginary_tpu.imgtype import determine_image_type
 
     if determine_image_type(buf) not in (ImageType.JPEG, ImageType.SVG):
         return 1
     try:
-        meta = codecs.probe_fast(buf)
+        if meta is None:
+            meta = codecs.probe_fast(buf)
         return choose_decode_shrink(name, o, meta.height, meta.width,
                                     meta.orientation, max(3, meta.channels))
     except ImageError:
@@ -174,6 +180,7 @@ def process_pipeline(
     o: ImageOptions,
     watermark_fetcher: Optional[WatermarkFetcher] = None,
     runner=None,
+    meta=None,
 ) -> ProcessedImage:
     """Fused multi-op pipeline (ref: Pipeline, image.go:379-410).
 
@@ -194,7 +201,7 @@ def process_pipeline(
     first = o.operations[0]
     if first.name in OPERATION_NAMES:
         try:
-            shrink = _pick_shrink(first.name, buf, build_params_from_operation(first))
+            shrink = _pick_shrink(first.name, buf, build_params_from_operation(first), meta)
         except Exception:
             shrink = 1
 

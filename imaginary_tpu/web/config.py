@@ -51,6 +51,13 @@ class ServerOptions:
     use_mesh: bool = False
     n_devices: Optional[int] = None
     spatial: int = 1  # spatial mesh axis (W-sharding for >=4K inputs)
+    # host SIMD spill under link saturation: None = auto (spill only when the
+    # host has spare cores), True/False force it. Spilled pixels come from the
+    # host interpreter (same dims, PSNR-equivalent but not bit-identical);
+    # processed-image responses carry X-Imaginary-Backend: device|host so
+    # operators can detect mixed-backend traffic (/info and error responses
+    # never touch the executor and carry no such header).
+    host_spill: Optional[bool] = None
     prewarm: bool = False
     # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
     distributed: bool = False

@@ -76,6 +76,7 @@ class ImageService:
                 use_mesh=o.use_mesh,
                 n_devices=o.n_devices,
                 spatial=o.spatial,
+                host_spill=o.host_spill,
             )
         )
         import os as _os
@@ -136,10 +137,13 @@ class ImageService:
         elif opts.type and image_type(opts.type) is ImageType.UNKNOWN:
             raise ErrOutputFormat
 
-        # resolution guard (ref: controllers.go:101-110)
+        # resolution guard (ref: controllers.go:101-110). probe_fast is the
+        # header-only parser; the metadata is reused downstream so the hot
+        # path pays exactly one header parse per request.
+        meta = None
         if o.max_allowed_pixels > 0:
             try:
-                meta = codecs.probe(buf)
+                meta = codecs.probe_fast(buf)
                 if (meta.width * meta.height / 1_000_000.0) > o.max_allowed_pixels:
                     raise ErrResolutionTooBig
             except ImageError as e:
@@ -150,8 +154,8 @@ class ImageService:
         loop = asyncio.get_running_loop()
         wm_rgba = await self._prefetch_watermark(request, op_name, opts)
         try:
-            out = await loop.run_in_executor(
-                self.pool, self._process_sync, op_name, buf, opts, wm_rgba
+            out, placement = await loop.run_in_executor(
+                self.pool, self._process_sync, op_name, buf, opts, wm_rgba, meta
             )
         except ImageError:
             raise
@@ -159,6 +163,8 @@ class ImageService:
             raise new_error("Error processing image: " + str(e), 400) from None
 
         headers = {}
+        if placement:
+            headers["X-Imaginary-Backend"] = placement
         if vary:
             headers["Vary"] = vary
         if o.return_size and out.mime != "application/json":
@@ -193,11 +199,17 @@ class ImageService:
             arr = np.concatenate([arr, alpha], axis=2)
         return arr
 
-    def _process_sync(self, op_name, buf, opts, wm_rgba):
+    def _process_sync(self, op_name, buf, opts, wm_rgba, meta=None):
+        from imaginary_tpu.engine.executor import last_placement, reset_placement
+
         fetcher = (lambda url: wm_rgba) if wm_rgba is not None else None
-        return process_operation(
-            op_name, buf, opts, watermark_fetcher=fetcher, runner=self.executor.process
+        reset_placement()
+        out = process_operation(
+            op_name, buf, opts, watermark_fetcher=fetcher,
+            runner=self.executor.process, meta=meta,
         )
+        # placement was recorded by submit() on THIS worker thread
+        return out, last_placement()
 
 
 # --- simple controllers -------------------------------------------------------

@@ -59,30 +59,38 @@ def test_smartcrop_never_spills(img):
 
 
 def test_spill_triggers_when_device_saturated(img):
+    from imaginary_tpu.engine.executor import last_placement, reset_placement
+
     ex = Executor(ExecutorConfig(host_spill=True, spill_factor=1.0))
     try:
         # simulate a measured slow link: 1s per item drain
         ex._device_item_ms = 1000.0
         o = ImageOptions(width=64, height=48)
         plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
+        reset_placement()
         out = ex.process(img, plan)
         assert out.shape == (48, 64, 3)
         assert ex.stats.spilled == 1
         assert ex.stats.items == 0  # never reached the device queue
+        assert last_placement() == "host"  # X-Imaginary-Backend source
     finally:
         ex.shutdown()
 
 
 def test_no_spill_when_device_fast(img):
+    from imaginary_tpu.engine.executor import last_placement, reset_placement
+
     ex = Executor(ExecutorConfig(host_spill=True))
     try:
         ex._device_item_ms = 0.01  # fast PCIe-class link
         o = ImageOptions(width=64, height=48)
         plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
+        reset_placement()
         out = ex.process(img, plan)
         assert out.shape == (48, 64, 3)
         assert ex.stats.spilled == 0
         assert ex.stats.items == 1
+        assert last_placement() == "device"
     finally:
         ex.shutdown()
 

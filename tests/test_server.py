@@ -429,3 +429,26 @@ class TestPathPrefix:
             assert res.status == 200
 
         run(ServerOptions(path_prefix="/api/v1"), fn)
+
+
+class TestBackendHeader:
+    """X-Imaginary-Backend: operators must be able to detect mixed-backend
+    traffic (spilled pixels are PSNR-equivalent, not bit-identical)."""
+
+    def test_device_placement_header(self):
+        async def fn(client, _):
+            res = await client.post("/resize?width=100", data=multipart_jpg())
+            assert res.status == 200
+            assert res.headers["X-Imaginary-Backend"] == "device"
+
+        run(ServerOptions(), fn)
+
+    def test_host_spill_cli_flag(self):
+        from imaginary_tpu.cli import build_parser, options_from_args
+
+        for val, expect in (("auto", None), ("on", True), ("off", False)):
+            args = build_parser().parse_args(["--host-spill", val])
+            assert options_from_args(args).host_spill is expect
+        # default is auto
+        args = build_parser().parse_args([])
+        assert options_from_args(args).host_spill is None
