@@ -68,8 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="spatial mesh axis size (W-shard huge images across chips)")
     p.add_argument("--host-spill", default="auto", choices=["auto", "on", "off"],
                    help="spill to host SIMD when the device link saturates "
-                        "(auto = only with >=4 spare CPUs; spilled responses "
-                        "carry X-Imaginary-Backend: host)")
+                        "(auto = only when >=4 CPUs are available to this "
+                        "process; spilled responses carry "
+                        "X-Imaginary-Backend: host)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")

@@ -106,8 +106,28 @@ class ExecutorStats:
 _PLACEMENT = threading.local()
 
 
+def _available_cpus() -> int:
+    """CPUs actually usable by this process — the scheduler affinity mask,
+    not the host's core count (a --cpus=1 container on a 32-core host must
+    not auto-enable spill: the 'spare' cores it would use aren't ours)."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 def reset_placement() -> None:
     _PLACEMENT.value = None
+
+
+def note_placement(value: str) -> None:
+    """Record placement for plans that never reach submit() (identity
+    chains short-circuit in pipeline._run_stages). Identity output is
+    labeled 'device': the header exists to flag host-SIMD pixel
+    divergence, and untransformed pixels cannot diverge."""
+    _PLACEMENT.value = value
 
 
 def last_placement() -> Optional[str]:
@@ -132,10 +152,8 @@ class Executor:
     def __init__(self, config: Optional[ExecutorConfig] = None):
         self.config = config or ExecutorConfig()
         if self.config.host_spill is None:
-            import os
-
             self.config = dataclasses.replace(
-                self.config, host_spill=(os.cpu_count() or 1) >= 4
+                self.config, host_spill=_available_cpus() >= 4
             )
         self.stats = ExecutorStats()
         self._queue: queue_mod.Queue = queue_mod.Queue()

@@ -98,6 +98,9 @@ def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
     runner: (arr, plan) -> arr; defaults to the direct single-image path,
     the web layer passes Executor.process for micro-batched dispatch."""
     if not plan.stages:
+        from imaginary_tpu.engine.executor import note_placement
+
+        note_placement("device")  # no transform -> no host/device divergence
         return arr
     try:
         return (runner or chain_mod.run_single)(arr, plan)

@@ -79,9 +79,9 @@ class ImageService:
                 host_spill=o.host_spill,
             )
         )
-        import os as _os
+        from imaginary_tpu.engine.executor import _available_cpus
 
-        workers = o.cpus if o.cpus > 0 else max(4, int(_os.cpu_count() or 4))
+        workers = o.cpus if o.cpus > 0 else max(4, _available_cpus())
         self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="itpu-host")
 
     async def close(self):

@@ -46,6 +46,122 @@ def test_init_distributed_single_process_fleet():
     assert "DIST_OK 1" in r.stdout
 
 
+_WORKER = r"""
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax.sharding import PartitionSpec as P
+from imaginary_tpu.parallel.mesh import batch_sharding, get_mesh, init_distributed
+
+PID = {pid}
+init_distributed(coordinator_address="127.0.0.1:{port}",
+                 num_processes=2, process_id=PID)
+assert jax.process_count() == 2, jax.process_count()
+mesh = get_mesh()  # one GLOBAL mesh spanning both processes' devices
+
+# 1) one collective across the fleet: psum over the batch axis rides the
+#    cross-process (DCN-analogue) link
+sharding = batch_sharding(mesh)
+n_local = len(jax.local_devices())
+n_global = mesh.devices.shape[0] * mesh.devices.shape[1]
+x = jax.make_array_from_process_local_data(
+    sharding, np.full((n_local,), float(PID + 1), np.float32), (n_global,))
+f = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "batch"),
+                          mesh=mesh, in_specs=P("batch"), out_specs=P()))
+total = float(np.asarray(f(x).addressable_shards[0].data).ravel()[0])
+expect = n_local * (1.0 + 2.0)  # each process contributes n_local shards
+assert total == expect, (total, expect)
+print("PSUM_OK", total == expect)
+
+# 2) one dp-sharded chain step: each process contributes its local images;
+#    the jitted chain runs once over the global mesh
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.plan import plan_operation
+
+h_in, w_in = 32, 48
+plan = plan_operation("resize", ImageOptions(width=16, height=12, force=True),
+                      h_in, w_in, 0, 3)
+imgs = [np.random.default_rng(1000 * PID + j).integers(
+            0, 256, (h_in, w_in, 3), dtype=np.uint8)
+        for j in range(n_local)]
+padded = np.stack([chain_mod.pad_to_bucket(a) for a in imgs])
+gx = jax.make_array_from_process_local_data(sharding, padded,
+                                            (n_global,) + padded.shape[1:])
+gh = jax.make_array_from_process_local_data(
+    sharding, np.full((n_local,), h_in, np.int32), (n_global,))
+gw = jax.make_array_from_process_local_data(
+    sharding, np.full((n_local,), w_in, np.int32), (n_global,))
+gdyns = tuple(
+    {{k: jax.make_array_from_process_local_data(
+        sharding, np.asarray(v), (n_global,) + np.asarray(v).shape[1:])
+      for k, v in d.items()}}
+    for d in chain_mod._stack_dyns([plan] * n_local))
+fn = jax.jit(chain_mod._run_chain, static_argnums=0)
+y, _, _ = fn(plan.spec_key(), gx, gh, gw, gdyns)
+for s in y.addressable_shards:
+    local_idx = s.index[0].start - PID * n_local
+    mine = np.asarray(s.data)[0, :plan.out_h, :plan.out_w]
+    ref = chain_mod.run_single(imgs[local_idx], plan)  # single-device oracle
+    assert np.array_equal(mine, ref), "sharded chain output diverged"
+print("CHAIN_OK", (plan.out_h, plan.out_w))
+"""
+
+
+def test_two_process_fleet_psum_and_sharded_chain():
+    """A REAL 2-process fleet (coordinator + worker subprocesses): global
+    mesh, one cross-process psum, one dp-sharded chain step whose shards
+    are bit-identical to the single-device oracle (SURVEY.md section 5.8;
+    VERDICT r2 next #5)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER.format(pid=i, port=port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_ROOT, env=env,
+        )
+        for i in range(2)
+    ]
+    # Poll both: if one worker dies early its peer blocks in
+    # init_distributed until the timeout — report the dead worker's real
+    # stderr instead of burning 5 minutes on a bare TimeoutExpired.
+    import time
+
+    outs = [None, None]
+    deadline = time.monotonic() + 300
+    try:
+        while any(o is None for o in outs) and time.monotonic() < deadline:
+            for i, p in enumerate(procs):
+                if outs[i] is None and p.poll() is not None:
+                    out, err = p.communicate()
+                    outs[i] = (p.returncode, out, err)
+            if any(o is not None and o[0] != 0 for o in outs):
+                break  # a worker failed: don't wait out its blocked peer
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, p in enumerate(procs):
+        if outs[i] is None:
+            out, err = p.communicate()
+            outs[i] = (p.returncode, out, err)
+
+    fails = [(rc, out, err) for rc, out, err in outs if rc != 0]
+    if any("distributed" in (err or "").lower() for _, _, err in fails):
+        pytest.skip(f"jax.distributed unavailable here: {fails[0][2][-200:]}")
+    assert not fails, "\n--- worker stderr ---\n".join(err[-2000:] for _, _, err in fails)
+    for rc, out, err in outs:
+        assert "PSUM_OK True" in out
+        assert "CHAIN_OK" in out
+
+
 def test_cli_flags_thread_through():
     from imaginary_tpu.cli import build_parser, options_from_args
 

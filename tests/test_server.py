@@ -440,6 +440,15 @@ class TestBackendHeader:
             res = await client.post("/resize?width=100", data=multipart_jpg())
             assert res.status == 200
             assert res.headers["X-Imaginary-Backend"] == "device"
+            # identity plans (re-encode only) never reach the executor but
+            # still carry the header: untouched pixels cannot diverge
+            res = await client.post("/convert?type=png", data=multipart_jpg())
+            assert res.status == 200
+            assert res.headers["X-Imaginary-Backend"] == "device"
+            # /info never produces pixels: no header
+            res = await client.post("/info", data=multipart_jpg())
+            assert res.status == 200
+            assert "X-Imaginary-Backend" not in res.headers
 
         run(ServerOptions(), fn)
 
