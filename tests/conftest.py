@@ -49,3 +49,13 @@ def fixture_bytes(name: str) -> bytes:
         generate_all(FIXTURES)
     with open(path, "rb") as f:
         return f.read()
+
+
+def psnr(a, b) -> float:
+    """Shared PSNR helper (single definition for every grading suite)."""
+    import numpy as np
+
+    mse = np.mean((np.asarray(a, np.float64) - np.asarray(b, np.float64)) ** 2)
+    if mse == 0:
+        return 99.0
+    return float(10.0 * np.log10(255.0 * 255.0 / mse))

@@ -1,0 +1,81 @@
+"""Golden parity suite (VERDICT r2 next #6, adapted to this environment).
+
+Two layers:
+
+1. The reference's exact arithmetic: calculateDestinationFitDimension's
+   table (image_test.go:146-180) against our _fit_dims — value-for-value,
+   including both rounding-direction cases.
+2. Committed pixel goldens for the reference op matrix on the 550x740
+   fixture (tests/goldens/, produced by gen_goldens.py): dimensions must
+   match the reference's assertSize expectations EXACTLY, and pixels must
+   stay within a tight PSNR floor of the committed goldens so numeric
+   changes (kernel swaps, dtype defaults, shrink-on-load decisions) cannot
+   silently move output pixels. libvips itself is not installable here
+   (zero egress), so the goldens pin OUR device path; independent-oracle
+   accuracy (PIL Lanczos etc.) is test_quality.py's responsibility.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tests.gen_goldens import GOLDEN_DIR, MATRIX, SMARTCROP, _run_case, _smartcrop_window
+from tests.conftest import fixture_bytes, psnr as _psnr
+
+
+class TestFitDimensionTable:
+    # image_test.go:146-180, verbatim cases incl. both rounding directions
+    CASES = [
+        (1280, 1000, 710, 9999, 710, 555),
+        (1279, 1000, 710, 9999, 710, 555),
+        (900, 500, 312, 312, 312, 173),  # rounding down
+        (900, 500, 313, 313, 313, 174),  # rounding up
+        (1299, 2000, 710, 999, 649, 999),
+        (1500, 2000, 710, 999, 710, 947),
+    ]
+
+    @pytest.mark.parametrize("iw,ih,ow,oh,fw,fh", CASES)
+    def test_reference_table(self, iw, ih, ow, oh, fw, fh):
+        from imaginary_tpu.ops.plan import _fit_dims
+
+        assert _fit_dims(iw, ih, ow, oh) == (fw, fh)
+
+
+class TestGoldenMatrix:
+    @pytest.mark.parametrize("name,op,kw,expect_wh", MATRIX,
+                             ids=[m[0] for m in MATRIX])
+    def test_dims_and_pixels(self, name, op, kw, expect_wh):
+        # committed goldens are required: missing means gen_goldens.py
+        # wasn't re-run after adding a matrix row — fail, don't skip
+        golden_path = os.path.join(GOLDEN_DIR, f"{name}.png")
+        assert os.path.exists(golden_path), f"missing golden {name} — run gen_goldens.py"
+        arr = _run_case(fixture_bytes("imaginary.jpg"), op, kw)
+        # dimension parity with the reference's assertSize expectations
+        assert (arr.shape[1], arr.shape[0]) == expect_wh
+        golden = np.asarray(Image.open(golden_path).convert("RGB"))
+        assert golden.shape == arr.shape
+        p = _psnr(arr, golden)
+        assert p >= 45.0, f"{name}: drifted from golden, PSNR {p:.1f} dB"
+
+    def test_smartcrop_golden(self):
+        name, op, kw, expect_wh = SMARTCROP
+        buf = fixture_bytes("smart-crop.jpg")
+        arr = _run_case(buf, op, kw)
+        assert (arr.shape[1], arr.shape[0]) == expect_wh
+        golden = np.asarray(
+            Image.open(os.path.join(GOLDEN_DIR, f"{name}.png")).convert("RGB")
+        )
+        p = _psnr(arr, golden)
+        assert p >= 45.0, f"smartcrop drifted from golden, PSNR {p:.1f} dB"
+        # the chosen window itself is pinned: a saliency regression moves
+        # the window even when the pixels inside still look plausible
+        with open(os.path.join(GOLDEN_DIR, "smartcrop_window.json")) as f:
+            want = json.load(f)
+        got = _smartcrop_window(buf, kw)
+        assert got == want, f"smartcrop window moved: {got} != {want}"

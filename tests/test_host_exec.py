@@ -10,10 +10,7 @@ from imaginary_tpu.ops import chain
 from imaginary_tpu.ops.plan import plan_operation
 
 
-def _psnr(a, b):
-    d = a.astype(np.float64) - b.astype(np.float64)
-    mse = (d * d).mean()
-    return 10 * np.log10(255.0**2 / max(mse, 1e-12))
+from tests.conftest import psnr as _psnr
 
 
 @pytest.fixture(scope="module")

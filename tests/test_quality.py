@@ -42,13 +42,12 @@ def _run(name, opts, arr):
     return chain_mod.run_single(arr, plan)
 
 
+from tests.conftest import psnr as _shared_psnr
+
+
 def psnr(a: np.ndarray, b: np.ndarray) -> float:
     assert a.shape == b.shape, (a.shape, b.shape)
-    d = a.astype(np.float64) - b.astype(np.float64)
-    mse = np.mean(d * d)
-    if mse == 0:
-        return 99.0
-    return 10.0 * np.log10(255.0 * 255.0 / mse)
+    return _shared_psnr(a, b)
 
 
 class TestResamplePSNR:
