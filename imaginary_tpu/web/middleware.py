@@ -42,7 +42,17 @@ def is_public_path(o: ServerOptions, path: str) -> bool:
 
 class GCRARateLimiter:
     """Generic cell rate algorithm, keyed by request method (the reference
-    uses throttled/v2 with VaryBy{Method}; middleware.go:125-145)."""
+    uses throttled/v2 with VaryBy{Method}; middleware.go:125-145).
+
+    MAX_KEYS mirrors the reference's memstore cap (middleware.go:131,
+    NewMemStore(65536)): today's key is the method (a handful of keys), but
+    the structure must not silently leak if a deployment rekeys it by
+    client. Expired entries (tat in the past contributes nothing) are
+    dropped first; if every key is live, the OLDEST-tat half is evicted —
+    clients closest to throttle (largest tat) keep their state, so a
+    key-flood cannot reset currently-throttled clients."""
+
+    MAX_KEYS = 65536
 
     def __init__(self, per_sec: int, burst: int):
         self.emission = 1.0 / max(per_sec, 1)
@@ -54,6 +64,12 @@ class GCRARateLimiter:
         """Returns (allowed, retry_after_seconds)."""
         now = time.monotonic()
         with self._lock:
+            if len(self._tat) >= self.MAX_KEYS and key not in self._tat:
+                self._tat = {k: t for k, t in self._tat.items() if t > now}
+                if len(self._tat) >= self.MAX_KEYS:
+                    keep = sorted(self._tat.items(), key=lambda kv: kv[1],
+                                  reverse=True)[: self.MAX_KEYS // 2]
+                    self._tat = dict(keep)
             tat = max(self._tat.get(key, now), now)
             if tat - now > self.tau:
                 return False, tat - self.tau - now

@@ -24,6 +24,30 @@ def test_entry_jits():
     assert out.dtype.name == "uint8"
 
 
+def test_dryrun_child_env():
+    """Unit-level coverage of the child-env construction (seconds, not the
+    ~2 min subprocess dryruns below): this is where the round-1 tunnel
+    hang would regress."""
+    mod = _load()
+    base = {
+        "XLA_FLAGS": "--foo=1 --xla_force_host_platform_device_count=8 --bar=2",
+        "JAX_PLATFORMS": "axon,cpu",
+        "PATH": "/usr/bin",
+    }
+    env = mod._dryrun_child_env(4, base)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["_ITPU_DRYRUN_CHILD"] == "1"
+    # the stale count flag is REPLACED, not appended after
+    assert env["XLA_FLAGS"].count("xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert "--foo=1" in env["XLA_FLAGS"] and "--bar=2" in env["XLA_FLAGS"]
+    assert env["PATH"] == "/usr/bin"  # everything else passes through
+    assert base["JAX_PLATFORMS"] == "axon,cpu"  # caller env untouched
+    # no pre-existing XLA_FLAGS at all
+    env2 = mod._dryrun_child_env(8, {})
+    assert env2["XLA_FLAGS"] == "--xla_force_host_platform_device_count=8"
+
+
 def test_dryrun_multichip_8():
     mod = _load()
     mod.dryrun_multichip(8)

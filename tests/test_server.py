@@ -461,3 +461,43 @@ class TestBackendHeader:
         # default is auto
         args = build_parser().parse_args([])
         assert options_from_args(args).host_spill is None
+
+
+class TestGCRAEviction:
+    def test_key_cap_evicts(self):
+        """The TAT map is bounded like the reference's memstore
+        (middleware.go:131, NewMemStore(65536)): rekeying the limiter by
+        client must not leak memory."""
+        import time as _time
+
+        from imaginary_tpu.web.middleware import GCRARateLimiter
+
+        rl = GCRARateLimiter(per_sec=1000, burst=1)
+        rl.MAX_KEYS = 8  # shadow the class cap for the test
+        for i in range(50):
+            rl.allow(f"client-{i}")
+        assert len(rl._tat) <= 8
+        # expired entries are preferred victims: after their tat passes,
+        # new keys slot in without nuking live state wholesale
+        _time.sleep(0.005)
+        rl.allow("fresh")
+        assert "fresh" in rl._tat and len(rl._tat) <= 8
+
+    def test_flood_does_not_reset_throttled_clients(self):
+        """A unique-key flood must not wipe a throttled client's state
+        (that would be a rate-limit bypass): eviction keeps the
+        LARGEST-tat half, and a client throttled through its burst
+        allowance has accumulated tat far above a one-shot flood key's."""
+        from imaginary_tpu.web.middleware import GCRARateLimiter
+
+        rl = GCRARateLimiter(per_sec=10, burst=3)  # emission 0.1s, tau 0.3s
+        rl.MAX_KEYS = 8
+        for _ in range(4):  # burn the burst: tat climbs ~0.4s ahead
+            rl.allow("victim")
+        blocked, retry = rl.allow("victim")
+        assert not blocked and retry > 0  # throttled now
+        for i in range(20):  # live-key flood past the cap
+            rl.allow(f"flood-{i}")
+        assert "victim" in rl._tat, "flood evicted a throttled client"
+        still_blocked, _ = rl.allow("victim")
+        assert not still_blocked, "flood reset a throttled client's TAT"
