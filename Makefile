@@ -1,8 +1,18 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench serve clean
+.PHONY: all native test bench serve clean gate
 
 all: native test
+
+# No-red-snapshot gate (VERDICT r2 next #1): run before ANY commit meant
+# to be a round snapshot. Green means: full suite passes, the driver's
+# entry + 8-device dryrun execute, and bench.py emits its JSON line
+# (CPU fallback allowed — the gate checks the machinery, not the chip).
+gate: test
+	python __graft_entry__.py
+	BENCH_DURATION=2 BENCH_THREADS=8 python bench.py || \
+	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + bench all pass"
 
 native:
 	python -m imaginary_tpu.native.build

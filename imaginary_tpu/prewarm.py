@@ -52,19 +52,54 @@ _COMMON = [
 ]
 
 
-def prewarm_common_chains(batch_sizes=(1,), verbose: bool = True) -> int:
-    """Compile the common chain matrix; returns number of programs built."""
+def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
+    """Compile the common chain matrix; returns number of programs built.
+
+    Two production realities shape what gets warmed:
+      - the executor pads micro-batches to powers of two, so every ladder
+        size up to max_batch is its own XLA program — warming only b=1
+        leaves the first loaded minute paying three more compiles per
+        chain (the latency harness measured those stalls snowballing an
+        open-loop queue);
+      - JPEG requests decode at the proven shrink-on-load fraction, so the
+        bucket production actually serves is the SHRUNK one, not the full
+        source dims.
+    """
+    if batch_sizes is None:
+        env = os.environ.get("IMAGINARY_TPU_PREWARM_BATCHES", "1,2,4,8")
+        try:
+            batch_sizes = tuple(int(x) for x in env.split(",") if x.strip())
+        except ValueError:
+            batch_sizes = (1, 2, 4, 8)  # degrade, never die before bind
+    from imaginary_tpu.ops.plan import choose_decode_shrink
+
     built = 0
+    seen = set()
     t0 = time.time()
     for op, opts, (h, w) in _COMMON:
-        for b in batch_sizes:
+        try:
+            shrink = choose_decode_shrink(op, opts, h, w, 0, 3)
+        except Exception:
+            shrink = 1
+        # warm the full bucket (PNG/WebP traffic decodes full-size) AND the
+        # shrink-on-load bucket JPEG traffic actually serves
+        dims = {(h, w), ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink)}
+        for dh, dw in dims:
             try:
-                plan = plan_operation(op, opts, h, w, 0, 3)
-                arr = np.zeros((h, w, 3), dtype=np.uint8)
-                chain_mod.run_batch([arr] * b, [plan] * b)
-                built += 1
+                plan = plan_operation(op, opts, dh, dw, 0, 3)
             except Exception:
                 continue
+            for b in batch_sizes:
+                key = (plan.spec_key(), chain_mod.bucket_shape(dh, dw), b)
+                if key in seen:
+                    continue
+                seen.add(key)
+                try:
+                    arr = np.zeros((dh, dw, 3), dtype=np.uint8)
+                    chain_mod.run_batch([arr] * b, [plan] * b)
+                    built += 1
+                except Exception:
+                    continue
     if verbose:
         print(f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s")
     return built

@@ -1,0 +1,61 @@
+"""Compile-cache warming (prewarm.py): ladder + shrink-bucket coverage."""
+
+import numpy as np
+
+from imaginary_tpu.options import ImageOptions
+
+
+def test_prewarm_ladder_and_shrink_bucket(monkeypatch):
+    """Prewarm compiles every requested batch size, at the SHRUNK decode
+    dims production serves (not the full source dims), deduped by
+    (chain, bucket, batch)."""
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.ops import chain as chain_mod
+    from imaginary_tpu.ops.plan import choose_decode_shrink
+
+    monkeypatch.setattr(
+        prewarm, "_COMMON", [("resize", ImageOptions(width=24), (64, 96))]
+    )
+    before = chain_mod.cache_size()
+    n = prewarm.prewarm_common_chains(batch_sizes=(1, 2), verbose=False)
+    # both the full bucket (PNG/WebP traffic) and the shrink-on-load bucket
+    # (JPEG traffic) are warmed, per batch size, deduped by (chain, bucket, b)
+    shrink = choose_decode_shrink("resize", ImageOptions(width=24), 64, 96, 0, 3)
+    expected_dims = {(64, 96), ((64 + shrink - 1) // shrink, (96 + shrink - 1) // shrink)}
+    assert n == 2 * len(expected_dims)
+    assert chain_mod.cache_size() >= before  # programs landed in the cache
+
+
+def test_prewarm_env_override(monkeypatch):
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.ops.plan import choose_decode_shrink
+
+    monkeypatch.setattr(
+        prewarm, "_COMMON", [("resize", ImageOptions(width=16), (32, 48))]
+    )
+    shrink = choose_decode_shrink("resize", ImageOptions(width=16), 32, 48, 0, 3)
+    dims = {(32, 48), ((32 + shrink - 1) // shrink, (48 + shrink - 1) // shrink)}
+    monkeypatch.setenv("IMAGINARY_TPU_PREWARM_BATCHES", "1")
+    assert prewarm.prewarm_common_chains(verbose=False) == len(dims)
+
+
+def test_prewarm_bad_env_degrades(monkeypatch):
+    """Malformed batch env must not kill the server before bind."""
+    from imaginary_tpu import prewarm
+
+    monkeypatch.setattr(
+        prewarm, "_COMMON", [("resize", ImageOptions(width=16), (32, 48))]
+    )
+    monkeypatch.setenv("IMAGINARY_TPU_PREWARM_BATCHES", "1 2;bogus")
+    assert prewarm.prewarm_common_chains(verbose=False) >= 1  # fell back to ladder
+
+
+def test_persistent_cache_degrades_on_unwritable(monkeypatch):
+    """chmod can't stop root, so simulate the read-only fs directly."""
+    from imaginary_tpu import prewarm
+
+    def boom(*a, **k):
+        raise PermissionError("read-only file system")
+
+    monkeypatch.setattr(prewarm.os, "makedirs", boom)
+    assert prewarm.enable_persistent_cache("/ro/cache") == ""  # degrade, not die
