@@ -63,6 +63,16 @@ class ExecutorConfig:
     host_spill: Optional[bool] = None
     spill_factor: float = 6.0
     probe_interval: int = 64
+    # Device circuit breaker (SURVEY.md section 5.3): the TPU link can die
+    # mid-serving (tunnel drop, preemption). After breaker_threshold
+    # CONSECUTIVE failed device dispatches/drains, host-executable requests
+    # fail over to the host SIMD interpreter instead of 400-ing one by one;
+    # after breaker_cooldown_s the next request probes the device again —
+    # one more failure re-opens instantly (the consecutive count only
+    # resets on a device success). Independent of host_spill: spill is a
+    # throughput policy, the breaker is an availability policy.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
 
 
 @dataclasses.dataclass
@@ -76,6 +86,9 @@ class ExecutorStats:
     spilled: int = 0
     spill_errors: int = 0  # host-spill attempts that fell back to the device
     spatial_batches: int = 0  # device calls that W-sharded over the mesh
+    device_failures: int = 0  # failed device dispatch/drain events
+    breaker_opens: int = 0  # times the circuit breaker tripped
+    breaker_host_served: int = 0  # requests served by host during an outage
     device_item_ms: float = 0.0  # measured per-item drain cost (cost model)
     host_item_ms: float = 0.0  # measured host-spill execution cost
 
@@ -92,6 +105,9 @@ class ExecutorStats:
             "spilled": self.spilled,
             "spill_errors": self.spill_errors,
             "spatial_batches": self.spatial_batches,
+            "device_failures": self.device_failures,
+            "breaker_opens": self.breaker_opens,
+            "breaker_host_served": self.breaker_host_served,
             "device_item_ms": round(self.device_item_ms, 3),
             "host_item_ms": round(self.host_item_ms, 3),
         }
@@ -189,6 +205,8 @@ class Executor:
         self._inflight_lock = threading.Lock()
         self._device_owed = 0
         self._owed_lock = threading.Lock()
+        self._consec_device_failures = 0
+        self._breaker_open_until = 0.0  # monotonic; 0 = closed
         self._device_item_ms: Optional[float] = None  # EWMA, fetcher-updated
         self._host_item_ms: float = 2.0  # EWMA, bootstrap estimate
         self._spill_seen = 0
@@ -213,6 +231,20 @@ class Executor:
         if not plan.stages:  # identity chain: no device work at all
             item.future.set_result(arr)
             return item.future
+        if self._breaker_is_open() and host_exec.can_execute(plan, for_spill=False):
+            # device outage: serve from the host interpreter rather than
+            # 400-ing. ALL host-executable traffic fails over together, so
+            # outputs stay consistent within the outage window. Plans the
+            # host can't run still go to the device (and surface its error).
+            try:
+                out = host_exec.run(arr, plan)
+            except Exception:
+                pass  # fall through: let the device path report
+            else:
+                self.stats.breaker_host_served += 1
+                _PLACEMENT.value = "host"
+                item.future.set_result(out)
+                return item.future
         if self.config.host_spill and self._should_spill(plan):
             t0 = time.monotonic()
             try:
@@ -241,6 +273,31 @@ class Executor:
     def _on_done(self, _fut) -> None:
         with self._owed_lock:
             self._device_owed -= 1
+
+    def _breaker_is_open(self) -> bool:
+        with self._owed_lock:
+            return time.monotonic() < self._breaker_open_until
+
+    def _note_device_failure(self) -> None:
+        """One failed dispatch/drain EVENT (a batch, not per item)."""
+        with self._owed_lock:
+            self._consec_device_failures += 1
+            self.stats.device_failures += 1
+            if (
+                self._consec_device_failures >= self.config.breaker_threshold
+                and time.monotonic() >= self._breaker_open_until
+            ):
+                self._breaker_open_until = (
+                    time.monotonic() + self.config.breaker_cooldown_s
+                )
+                self.stats.breaker_opens += 1
+                # count persists: after cooldown ONE more failure re-opens;
+                # only a device success resets it
+
+    def _note_device_ok(self) -> None:
+        with self._owed_lock:
+            self._consec_device_failures = 0
+            self._breaker_open_until = 0.0
 
     def _should_spill(self, plan: ImagePlan) -> bool:
         dev_ms = self._device_item_ms
@@ -388,6 +445,7 @@ class Executor:
                 y, arrs, plans = self._launch_chunk(sub)
                 chunks.append((y, arrs, plans, sub))
         except Exception as e:
+            self._note_device_failure()
             for it in items:
                 it.future.set_exception(e)
             return
@@ -418,12 +476,14 @@ class Executor:
                 t_ready = time.monotonic()
                 fetched = chain_mod.fetch_groups([c[0] for c in chunks])
             except Exception as e:
+                self._note_device_failure()
                 for _, _, _, sub in chunks:
                     for it in sub:
                         it.future.set_exception(e)
                 with self._inflight_lock:
                     self._inflight -= 1
                 continue
+            self._note_device_ok()
             # Normalize the drain cost to half-group amortization: the D2H
             # link has a large fixed cost, so a singleton probe drain must
             # not be booked at its raw per-item price — that would lock the
