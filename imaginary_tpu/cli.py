@@ -61,15 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="store_true")
     # TPU engine flags (no reference counterpart)
     p.add_argument("--batch-window-ms", type=float, default=3.0, help="micro-batch window")
-    p.add_argument("--max-batch", type=int, default=8, help="micro-batch size cap")
+    p.add_argument("--max-batch", type=int, default=16, help="micro-batch size cap")
     p.add_argument("--use-mesh", action="store_true", help="shard batches over the device mesh")
     p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
     p.add_argument("--spatial", type=int, default=1,
                    help="spatial mesh axis size (W-shard huge images across chips)")
     p.add_argument("--host-spill", default="auto", choices=["auto", "on", "off"],
                    help="spill to host SIMD when the device link saturates "
-                        "(auto = only when >=4 CPUs are available to this "
-                        "process; spilled responses carry "
+                        "(auto = enabled, governed by the measured cost "
+                        "model; spilled responses carry "
                         "X-Imaginary-Backend: host)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
     p.add_argument("--distributed", action="store_true",

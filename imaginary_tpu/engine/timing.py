@@ -24,8 +24,9 @@ STAGES = (
     "probe",        # header-only metadata parse
     "decode",       # host codec decode (incl. shrink-on-load)
     "queue_wait",   # submit -> device-call launch
-    "device_wait",  # fetch start -> outputs ready (H2D + compute, amortized/item)
-    "d2h",          # device->host readback (amortized/item)
+    "drain",        # fetch start -> host bytes landed (one sync, amortized/item)
+    "device_wait",  # split mode only: fetch start -> outputs ready (H2D + compute)
+    "d2h",          # split mode only: device->host readback (amortized/item)
     "host_spill",   # host SIMD interpreter execution (spilled items)
     "encode",       # host codec encode
     "total",        # whole processing call

@@ -65,12 +65,19 @@ def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
         bucket production actually serves is the SHRUNK one, not the full
         source dims.
     """
+    from imaginary_tpu.engine.executor import batch_ladder
+
     if batch_sizes is None:
-        env = os.environ.get("IMAGINARY_TPU_PREWARM_BATCHES", "1,2,4,8")
-        try:
-            batch_sizes = tuple(int(x) for x in env.split(",") if x.strip())
-        except ValueError:
-            batch_sizes = (1, 2, 4, 8)  # degrade, never die before bind
+        env = os.environ.get("IMAGINARY_TPU_PREWARM_BATCHES", "")
+        if env:
+            try:
+                batch_sizes = tuple(int(x) for x in env.split(",") if x.strip())
+            except ValueError:
+                batch_sizes = batch_ladder()  # degrade, never die before bind
+        else:
+            # derive from the executor's chunk cap so every padded batch
+            # size a default deployment can form is compiled before bind
+            batch_sizes = batch_ladder()
     from imaginary_tpu.ops.plan import choose_decode_shrink
 
     built = 0

@@ -47,7 +47,9 @@ class ServerOptions:
     cpus: int = 0  # host worker-thread cap, 0 = auto (role of -cpus/GOMAXPROCS)
     # --- TPU engine knobs (no reference counterpart) -------------------------
     batch_window_ms: float = 3.0
-    max_batch: int = 8
+    # default mirrors engine.executor.MAX_BATCH (kept literal here so this
+    # config module stays import-light; test_engine pins the two equal)
+    max_batch: int = 16
     use_mesh: bool = False
     n_devices: Optional[int] = None
     spatial: int = 1  # spatial mesh axis (W-sharding for >=4K inputs)
