@@ -36,7 +36,12 @@ class DecodedImage:
 
 @dataclasses.dataclass
 class ImageMetadata:
-    """The `/info` contract (ref: image.go:41-50, ImageInfo JSON)."""
+    """The `/info` contract (ref: image.go:41-50, ImageInfo JSON).
+
+    subsampling is an internal extra (not part of the /info JSON): the JPEG
+    chroma layout ("420"/"422"/"444"/"gray", "" when unknown/not JPEG), used
+    to gate the packed-YUV420 device transport.
+    """
 
     width: int
     height: int
@@ -46,6 +51,7 @@ class ImageMetadata:
     has_profile: bool
     channels: int
     orientation: int
+    subsampling: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -86,6 +92,81 @@ class EncodeOptions:
 SPECIAL_TYPES = frozenset(
     {ImageType.SVG, ImageType.PDF, ImageType.HEIF, ImageType.AVIF}
 )
+
+
+@dataclasses.dataclass
+class YuvPlanes:
+    """Raw 4:2:0 planes: Y is (h, w) uint8, U/V are (ceil(h/2), ceil(w/2)).
+
+    The packed-transport output format: the device returns these instead of
+    RGB for JPEG-in/JPEG-out requests, and encode_yuv() writes them through
+    libjpeg's raw-data path with zero host color math.
+    """
+
+    y: np.ndarray
+    u: np.ndarray
+    v: np.ndarray
+
+    @property
+    def height(self) -> int:
+        return self.y.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.y.shape[1]
+
+
+def unpack_planes(packed: np.ndarray, h: int, w: int, hb: int, wb: int) -> YuvPlanes:
+    """Slice Y/U/V out of the packed transport layout (the ONE definition
+    of the layout's geometry on the Python side; the C++ packer in
+    native/codecs.cpp mirrors it): Y in rows [0, hb), chroma block below
+    with U in columns [0, wb/2) and V in [wb/2, wb)."""
+    ch, cw = (h + 1) // 2, (w + 1) // 2
+    a = packed[..., 0] if packed.ndim == 3 else packed
+    return YuvPlanes(
+        y=np.ascontiguousarray(a[:h, :w]),
+        u=np.ascontiguousarray(a[hb : hb + ch, :cw]),
+        v=np.ascontiguousarray(a[hb : hb + ch, wb // 2 : wb // 2 + cw]),
+    )
+
+
+def yuv_planes_to_rgb(p: YuvPlanes) -> np.ndarray:
+    """BT.601 full-range planes -> HWC uint8 RGB (nearest chroma upsample).
+
+    The escape hatch for rare cases where packed-transport output must feed
+    a non-JPEG encoder (mid-pipeline type switch) or the raw encoder fails.
+    """
+    h, w = p.y.shape
+    yf = p.y.astype(np.float32)
+    u = p.u.astype(np.float32).repeat(2, 0)[:h].repeat(2, 1)[:, :w] - 128.0
+    v = p.v.astype(np.float32).repeat(2, 0)[:h].repeat(2, 1)[:, :w] - 128.0
+    r = yf + 1.402 * v
+    g = yf - 0.344136 * u - 0.714136 * v
+    b = yf + 1.772 * u
+    return np.clip(np.stack([r, g, b], axis=-1) + 0.5, 0, 255).astype(np.uint8)
+
+
+def yuv420_supported() -> bool:
+    """True when the active backend is the native extension with the
+    packed-YUV420 transport entry points."""
+    b = _backend()
+    fn = getattr(b, "yuv420_supported", None)
+    return bool(fn and fn())
+
+
+def decode_yuv420(buf: bytes, shrink: int, hb: int, wb: int):
+    """Packed-layout 4:2:0 decode; see native_backend.decode_yuv420."""
+    return _backend().decode_yuv420(buf, shrink, hb, wb)
+
+
+def encode_yuv(planes: YuvPlanes, opts: EncodeOptions) -> bytes:
+    """Encode raw planes as JPEG via the native raw-data path."""
+    if opts.type is not ImageType.JPEG:
+        raise CodecError("raw YUV planes can only encode to JPEG", 500)
+    return _backend().encode_yuv420(
+        planes.y, planes.u, planes.v,
+        opts.effective_quality(), opts.interlace,
+    )
 
 
 def _pil_open_rgba(buf: bytes):

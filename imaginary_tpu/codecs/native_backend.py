@@ -86,14 +86,54 @@ def probe(buf: bytes, t: ImageType) -> ImageMetadata:
 
 def _native_probe(buf: bytes, t: ImageType) -> ImageMetadata:
     try:
-        w, h, c, has_alpha, orientation = _ext.probe(buf, t.value)
+        got = _ext.probe(buf, t.value)
     except Exception as e:
         raise CodecError(f"Cannot retrieve image metadata: {e}", 400) from None
+    subsampling = ""
+    if len(got) >= 6:  # ABI 2 reports JPEG chroma subsampling
+        w, h, c, has_alpha, orientation, subsampling = got[:6]
+    else:  # pragma: no cover - stale extension build
+        w, h, c, has_alpha, orientation = got
     return ImageMetadata(
         width=w, height=h, type=t.value, space="srgb",
         has_alpha=bool(has_alpha), has_profile=False,
-        channels=c, orientation=orientation,
+        channels=c, orientation=orientation, subsampling=subsampling,
     )
+
+
+def yuv420_supported() -> bool:
+    """True when the built extension carries the packed-YUV420 transport
+    entry points (ABI 2+)."""
+    return _ext is not None and hasattr(_ext, "decode_yuv420")
+
+
+def decode_yuv420(buf: bytes, shrink: int, hb: int, wb: int):
+    """Decode a 4:2:0 JPEG straight into the packed transport layout.
+
+    Returns (packed [hb + hb/2, wb, 1] uint8, h, w, orientation); raises
+    CodecError("not-420") when the source isn't plain 4:2:0 YCbCr — callers
+    fall back to the RGB decode path.
+    """
+    denom = shrink if shrink in (2, 4, 8) else 1
+    try:
+        packed, h, w, orientation = _ext.decode_yuv420(buf, denom, hb, wb)
+    except Exception as e:
+        raise CodecError(f"Cannot decode image: {e}", 400) from None
+    arr = np.frombuffer(packed, dtype=np.uint8).reshape(hb + hb // 2, wb, 1)
+    return arr, h, w, orientation
+
+
+def encode_yuv420(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                  quality: int, progressive: bool) -> bytes:
+    """Raw-plane JPEG encode (no host color conversion / subsampling)."""
+    h, w = y.shape[:2]
+    try:
+        return _ext.encode_yuv420(
+            np.ascontiguousarray(y), np.ascontiguousarray(u),
+            np.ascontiguousarray(v), h, w, quality, 1 if progressive else 0,
+        )
+    except Exception as e:
+        raise CodecError(f"Cannot encode image: {e}", 400) from None
 
 
 def probe_fast(buf: bytes, t: ImageType) -> ImageMetadata:

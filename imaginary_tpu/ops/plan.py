@@ -54,14 +54,59 @@ class StageInstance:
 
 @dataclasses.dataclass
 class ImagePlan:
-    """Device work for one request: the chain key is (specs, in-bucket, C)."""
+    """Device work for one request: the chain key is (specs, in-bucket, C).
+
+    transport: "rgb" (HWC arrays both ways) or "yuv420" (packed subsampled
+    planes both ways — half the link bytes; JPEG-in/JPEG-out requests only).
+    For yuv420 plans the item array is the pre-padded packed buffer, so the
+    packed dims (in_bucket), the true image dims (in_h/in_w), and the output
+    Y bucket (out_bucket, for host-side plane slicing) ride on the plan.
+    """
 
     stages: list
     out_h: int
     out_w: int
+    transport: str = "rgb"
+    in_bucket: Optional[tuple] = None  # packed array dims (hb + hb/2, wb)
+    in_h: int = 0
+    in_w: int = 0
+    out_bucket: Optional[tuple] = None  # output Y bucket dims (hb, wb)
 
     def spec_key(self) -> tuple:
         return tuple(s.spec for s in self.stages)
+
+
+def wrap_plan_yuv420(plan: ImagePlan, src_h: int, src_w: int) -> ImagePlan:
+    """Re-express an RGB plan as a packed-YUV420-transport plan.
+
+    Prepends the device-side unpack (chroma upsample + YCbCr->RGB) and
+    appends the repack (RGB->YCbCr + 2x2 chroma pool); the wrapped chain is
+    the SAME RGB geometry in the middle, so every operation composes
+    unchanged. Identity plans return unchanged — the caller short-circuits
+    those straight from decoded planes to the raw encoder with no device
+    round-trip at all.
+    """
+    from imaginary_tpu.ops.stages import FromYuv420Spec, ToYuv420Spec
+
+    if not plan.stages:
+        return plan
+    hb, wb = bucket_shape(src_h, src_w)
+    out_hb, out_wb = _final_bucket(plan.stages, src_h, src_w)
+    stages = (
+        [StageInstance(FromYuv420Spec(hb, wb), {})]
+        + plan.stages
+        + [StageInstance(ToYuv420Spec(out_hb, out_wb), {})]
+    )
+    return ImagePlan(
+        stages=stages,
+        out_h=plan.out_h,
+        out_w=plan.out_w,
+        transport="yuv420",
+        in_bucket=(hb + hb // 2, wb),
+        in_h=src_h,
+        in_w=src_w,
+        out_bucket=(out_hb, out_wb),
+    )
 
 
 class _Planner:
@@ -664,6 +709,11 @@ def _tighten_output_bucket(p: _Planner, src_h: int, src_w: int) -> None:
     past bucket-preserving stages and retarget the last shape-bearing spec;
     if the chain has none (flip/rotate-only chains), append a static slice.
     """
+    if not p.stages:
+        # an empty chain is an identity: the executor short-circuits it
+        # host-side, so appending a bucket-shrink would turn a no-op into
+        # a device round-trip that returns the same pixels
+        return
     th, tw = tight_dim(p.h), tight_dim(p.w)
     hb, wb = _final_bucket(p.stages, src_h, src_w)
     if (th, tw) == (hb, wb):

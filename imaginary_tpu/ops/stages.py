@@ -348,6 +348,100 @@ class ShrinkBucketSpec:
         return x[:, : self.out_hb, : self.out_wb, :], h, w
 
 
+def _chroma_up_indices(out_n: int, cn, chroma_b: int):
+    """Index/weight vectors for centered 2x 1-D chroma upsampling.
+
+    out_n: static luma length; cn: dynamic [B] valid chroma length;
+    chroma_b: static chroma buffer length (for clamping). JPEG chroma
+    sample i sits at luma position 2i + 0.5, so luma position r maps to
+    chroma coordinate (r - 0.5) / 2 — the 1/4-3/4 tap weights of libjpeg's
+    fancy upsampler. Returns (i0, i1 [B, out_n] i32, t [out_n] f32).
+    """
+    r = jnp.arange(out_n, dtype=jnp.float32)
+    pos = r * 0.5 - 0.25
+    i0f = jnp.floor(pos)
+    t = pos - i0f
+    hi = jnp.maximum(cn - 1, 0).astype(jnp.int32)[:, None]
+    i0 = jnp.clip(i0f.astype(jnp.int32)[None, :], 0, hi)
+    i1 = jnp.clip(i0f.astype(jnp.int32)[None, :] + 1, 0, hi)
+    return i0, jnp.minimum(i1, chroma_b - 1), t
+
+
+@dataclasses.dataclass(frozen=True)
+class FromYuv420Spec:
+    """Unpack the packed YUV420 transport buffer into RGB.
+
+    Input x is [B, hb + hb/2, wb, 1]: Y plane in rows [0, hb); the chroma
+    block below holds U in columns [0, wb/2) and V in [wb/2, wb), each
+    ceil(h/2) x ceil(w/2) valid. Chroma upsamples 2x with the centered
+    triangle filter (libjpeg fancy-upsampling weights), then BT.601
+    full-range YCbCr -> RGB — the color math the host skipped runs here,
+    on the device, against half the transfer bytes.
+    """
+
+    hb: int
+    wb: int
+
+    def apply(self, x, h, w, dyn):
+        hb, wb = self.hb, self.wb
+        y = x[:, :hb, :, 0]
+        u = x[:, hb:, : wb // 2, 0]
+        v = x[:, hb:, wb // 2 :, 0]
+        ch = (h + 1) // 2
+        cw = (w + 1) // 2
+
+        def up2(plane):
+            # rows then cols, per-batch clamped gathers
+            i0, i1, t = _chroma_up_indices(hb, ch, hb // 2)
+            rows = jax.vmap(lambda p, a, b: (p[a], p[b]))(plane, i0, i1)
+            plane = rows[0] * (1.0 - t)[None, :, None] + rows[1] * t[None, :, None]
+            j0, j1, s = _chroma_up_indices(wb, cw, wb // 2)
+            cols = jax.vmap(lambda p, a, b: (p[:, a], p[:, b]))(plane, j0, j1)
+            return cols[0] * (1.0 - s)[None, None, :] + cols[1] * s[None, None, :]
+
+        uu = up2(u) - 128.0
+        vv = up2(v) - 128.0
+        r = y + 1.402 * vv
+        g = y - 0.344136 * uu - 0.714136 * vv
+        b = y + 1.772 * uu
+        rgb = jnp.clip(jnp.stack([r, g, b], axis=-1), 0.0, 255.0)
+        return rgb, h, w
+
+
+@dataclasses.dataclass(frozen=True)
+class ToYuv420Spec:
+    """Pack RGB back into the YUV420 transport layout for the readback.
+
+    Input x is [B, hb, wb, 3] RGB; output [B, hb + hb/2, wb, 1] packed
+    planes. Chroma is 2x2 box-averaged over VALID pixels only (masked by
+    the dynamic dims, so bucket padding never tints edge chroma) — the
+    downsample the host encoder would otherwise do per image.
+    """
+
+    hb: int
+    wb: int
+
+    def apply(self, x, h, w, dyn):
+        hb, wb = self.hb, self.wb
+        x = jnp.clip(x, 0.0, 255.0)
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        y = 0.299 * r + 0.587 * g + 0.114 * b
+        cb = -0.168736 * r - 0.331264 * g + 0.5 * b + 128.0
+        cr = 0.5 * r - 0.418688 * g - 0.081312 * b + 128.0
+        iy = jnp.arange(hb, dtype=jnp.int32)[None, :, None]
+        ix = jnp.arange(wb, dtype=jnp.int32)[None, None, :]
+        m = ((iy < h[:, None, None]) & (ix < w[:, None, None])).astype(jnp.float32)
+
+        def pool(c):
+            s = (c * m).reshape(-1, hb // 2, 2, wb // 2, 2).sum(axis=(2, 4))
+            n = m.reshape(-1, hb // 2, 2, wb // 2, 2).sum(axis=(2, 4))
+            return jnp.where(n > 0, s / jnp.maximum(n, 1.0), 128.0)
+
+        bottom = jnp.concatenate([pool(cb), pool(cr)], axis=2)  # [B, hb/2, wb]
+        packed = jnp.concatenate([y, bottom], axis=1)[..., None]
+        return packed, h, w
+
+
 @dataclasses.dataclass(frozen=True)
 class GraySpec:
     """Rec.709 luma, broadcast back over RGB (colorspace=bw,

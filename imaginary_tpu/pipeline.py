@@ -23,17 +23,19 @@ import numpy as np
 
 from imaginary_tpu import codecs
 from imaginary_tpu.engine.timing import TIMES
-from imaginary_tpu.codecs import EncodeOptions
+from imaginary_tpu.codecs import EncodeOptions, YuvPlanes
 from imaginary_tpu.errors import ImageError, new_error
 from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
 from imaginary_tpu.options import ImageOptions
 from imaginary_tpu.params import build_params_from_operation
 from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.buckets import bucket_shape
 from imaginary_tpu.ops.plan import (
     OPERATION_NAMES,
     ImagePlan,
     choose_decode_shrink,
     plan_operation,
+    wrap_plan_yuv420,
 )
 
 # Ops servable over HTTP (ref: OperationsMap image.go:15-32 + /info + /pipeline)
@@ -65,8 +67,14 @@ def _encode_type(o: ImageOptions, source: ImageType) -> ImageType:
     return source if source in ENCODABLE else ImageType.JPEG
 
 
-def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedImage:
-    """Encode with the WEBP/HEIF/AVIF -> JPEG fallback (image.go:99-103)."""
+def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
+    """Encode with the WEBP/HEIF/AVIF -> JPEG fallback (image.go:99-103).
+
+    arr is an HWC uint8 array, or YuvPlanes from the packed transport —
+    those encode through the raw-plane JPEG path (no host color math); a
+    non-JPEG target (mid-pipeline type switch) or raw-encode failure
+    converts the planes to RGB and takes the normal path.
+    """
     opts = EncodeOptions(
         type=target,
         quality=o.quality,
@@ -77,6 +85,15 @@ def _encode(arr: np.ndarray, o: ImageOptions, target: ImageType) -> ProcessedIma
         strip_metadata=o.strip_metadata,
     )
     t0 = time.monotonic()
+    if isinstance(arr, YuvPlanes):
+        if target is ImageType.JPEG:
+            try:
+                body = codecs.encode_yuv(arr, opts)
+                TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
+                return ProcessedImage(body=body, mime=get_image_mime_type(target))
+            except ImageError:
+                pass  # fall through to the RGB encoder
+        arr = codecs.yuv_planes_to_rgb(arr)
     try:
         body = codecs.encode(arr, opts)
         actual = target
@@ -139,12 +156,28 @@ def process_operation(
         raise new_error(f"Unsupported operation: {name}", 400)
 
     t_start = time.monotonic()
+    from imaginary_tpu.imgtype import determine_image_type
+
+    src_type = determine_image_type(buf)
+    if meta is None and src_type in (ImageType.JPEG, ImageType.SVG):
+        try:
+            meta = codecs.probe_fast(buf)
+        except ImageError:
+            meta = None  # decode below raises the user-facing error
     shrink = _pick_shrink(name, buf, o, meta)
     t_probe = time.monotonic()
-    d = codecs.decode(buf, shrink)
-    t_decode = time.monotonic()
     TIMES.record("probe", (t_probe - t_start) * 1000.0)
-    TIMES.record("decode", (t_decode - t_probe) * 1000.0)
+
+    if _yuv_eligible(src_type, meta, o):
+        out = _process_yuv420(name, buf, o, meta, shrink,
+                              watermark_fetcher, runner, t_start)
+        if out is not None:
+            TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
+            return out
+
+    t0 = time.monotonic()
+    d = codecs.decode(buf, shrink)
+    TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
     wm = _fetch_watermark(name, o, watermark_fetcher)
     plan = plan_operation(
         name, o, d.array.shape[0], d.array.shape[1], d.orientation,
@@ -154,6 +187,68 @@ def process_operation(
     out = _encode(arr, o, _encode_type(o, d.type))
     TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
     return out
+
+
+def _yuv_eligible(src_type, meta, o: ImageOptions) -> bool:
+    """Gate for the packed-YUV420 transport: plain 4:2:0 JPEG in, JPEG out,
+    native raw codec available. Everything else rides the RGB path."""
+    if src_type is not ImageType.JPEG or meta is None:
+        return False
+    if meta.subsampling != "420":
+        return False
+    if o.type not in ("", "jpeg", "auto"):
+        return False
+    try:
+        return codecs.yuv420_supported()
+    except Exception:
+        return False
+
+
+def _decode_yuv_packed(buf, shrink, sh, sw):
+    """Raw-decode into the packed layout; None means 'use the RGB path'
+    (non-420 surprises, raw decode trouble, probe/decode disagreement —
+    the RGB decode then raises any user-facing error itself)."""
+    hb, wb = bucket_shape(sh, sw)
+    t0 = time.monotonic()
+    try:
+        packed, h, w, _orient = codecs.decode_yuv420(buf, shrink, hb, wb)
+    except ImageError:
+        return None
+    if (h, w) != (sh, sw):
+        return None
+    TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    return packed, hb, wb
+
+
+def _process_yuv420(name, buf, o, meta, shrink, watermark_fetcher, runner,
+                    t_start) -> Optional[ProcessedImage]:
+    """Serve a JPEG->JPEG request over the packed-plane transport.
+
+    Returns None to fall back to the RGB path — parameter-validation errors
+    still raise, exactly as the RGB path would, since the plan math is
+    identical. Decode runs before the watermark fetch so a fallback never
+    double-fetches the watermark or double-counts the decode stage.
+    """
+    sh = -(-meta.height // shrink)
+    sw = -(-meta.width // shrink)
+    got = _decode_yuv_packed(buf, shrink, sh, sw)
+    if got is None:
+        return None
+    packed, hb, wb = got
+    wm = _fetch_watermark(name, o, watermark_fetcher)
+    plan = plan_operation(name, o, sh, sw, meta.orientation, 3,
+                          watermark_rgba=wm)
+    if not plan.stages:
+        # identity chain (e.g. /convert jpeg->jpeg quality change): planes
+        # go straight back to the raw encoder — no device round-trip at all
+        from imaginary_tpu.engine.executor import note_placement
+
+        note_placement("device")
+        planes = codecs.unpack_planes(packed, sh, sw, hb, wb)
+        return _encode(planes, o, _encode_type(o, ImageType.JPEG))
+    wrapped = wrap_plan_yuv420(plan, sh, sw)
+    result = _run_stages(packed, wrapped, runner)
+    return _encode(result, o, _encode_type(o, ImageType.JPEG))
 
 
 def _pick_shrink(name: str, buf: bytes, o: ImageOptions, meta=None) -> int:
@@ -208,14 +303,49 @@ def process_pipeline(
         except Exception:
             shrink = 1
 
+    from imaginary_tpu.imgtype import determine_image_type
+
+    src_type = determine_image_type(buf)
+    if meta is None and src_type is ImageType.JPEG:
+        try:
+            meta = codecs.probe_fast(buf)
+        except ImageError:
+            meta = None
+    if _yuv_eligible(src_type, meta, o):
+        sh = -(-meta.height // shrink)
+        sw = -(-meta.width // shrink)
+        got = _decode_yuv_packed(buf, shrink, sh, sw)
+        if got is not None:
+            packed, hb, wb = got
+            combined, final_o, target = _build_pipeline_plan(
+                o, sh, sw, meta.orientation, 3, ImageType.JPEG, watermark_fetcher
+            )
+            if not combined.stages:
+                from imaginary_tpu.engine.executor import note_placement
+
+                note_placement("device")
+                planes = codecs.unpack_planes(packed, sh, sw, hb, wb)
+                return _encode(planes, final_o, target)
+            wrapped = wrap_plan_yuv420(combined, sh, sw)
+            result = _run_stages(packed, wrapped, runner)
+            return _encode(result, final_o, target)
+
     d = codecs.decode(buf, shrink)
-    cur_h, cur_w = d.array.shape[0], d.array.shape[1]
-    orientation = d.orientation
-    channels = d.array.shape[2]
+    combined, final_o, target = _build_pipeline_plan(
+        o, d.array.shape[0], d.array.shape[1], d.orientation,
+        d.array.shape[2], d.type, watermark_fetcher,
+    )
+    arr = _run_stages(d.array, combined, runner)
+    return _encode(arr, final_o, target)
+
+
+def _build_pipeline_plan(o, cur_h, cur_w, orientation, channels, src_type,
+                         watermark_fetcher):
+    """Concatenate every op's stages into one combined plan (pure host
+    math — no pixels needed, so both transports share it)."""
     stages: list = []
     final_o = o
-    target = _encode_type(o, d.type)
-
+    target = _encode_type(o, src_type)
     for i, op in enumerate(o.operations):
         if op.name not in OPERATION_NAMES:  # info/pipeline are not nestable
             raise new_error(f"Unsupported operation: {op.name}", 400)
@@ -237,11 +367,8 @@ def process_pipeline(
         orientation = 0  # EXIF applies once; later ops see upright pixels
         final_o = op_opts
         if op_opts.type:
-            target = _encode_type(op_opts, d.type)
-
-    combined = ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w)
-    arr = _run_stages(d.array, combined, runner)
-    return _encode(arr, final_o, target)
+            target = _encode_type(op_opts, src_type)
+    return ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w), final_o, target
 
 
 def _fetch_watermark(name, o, fetcher) -> Optional[np.ndarray]:

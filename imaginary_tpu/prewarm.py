@@ -91,22 +91,40 @@ def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
         # warm the full bucket (PNG/WebP traffic decodes full-size) AND the
         # shrink-on-load bucket JPEG traffic actually serves
         dims = {(h, w), ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink)}
+        try:
+            from imaginary_tpu import codecs as _codecs
+
+            warm_yuv = _codecs.yuv420_supported()
+        except Exception:
+            warm_yuv = False
         for dh, dw in dims:
             try:
                 plan = plan_operation(op, opts, dh, dw, 0, 3)
             except Exception:
                 continue
-            for b in batch_sizes:
-                key = (plan.spec_key(), chain_mod.bucket_shape(dh, dw), b)
-                if key in seen:
-                    continue
-                seen.add(key)
-                try:
-                    arr = np.zeros((dh, dw, 3), dtype=np.uint8)
-                    chain_mod.run_batch([arr] * b, [plan] * b)
-                    built += 1
-                except Exception:
-                    continue
+            plans = [(plan, None)]
+            if warm_yuv and plan.stages:
+                # JPEG traffic serves over the packed-YUV420 transport: warm
+                # that chain too, with a pre-padded packed dummy input
+                from imaginary_tpu.ops.plan import wrap_plan_yuv420
+
+                plans.append((wrap_plan_yuv420(plan, dh, dw), "yuv"))
+            for pl, kind in plans:
+                for b in batch_sizes:
+                    key = (pl.spec_key(), chain_mod.bucket_shape(dh, dw), b)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    try:
+                        if kind == "yuv":
+                            ph, wb = pl.in_bucket
+                            arr = np.zeros((ph, wb, 1), dtype=np.uint8)
+                        else:
+                            arr = np.zeros((dh, dw, 3), dtype=np.uint8)
+                        chain_mod.run_batch([arr] * b, [pl] * b)
+                        built += 1
+                    except Exception:
+                        continue
     if verbose:
         print(f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s")
     return built

@@ -19,10 +19,15 @@ def test_prewarm_ladder_and_shrink_bucket(monkeypatch):
     before = chain_mod.cache_size()
     n = prewarm.prewarm_common_chains(batch_sizes=(1, 2), verbose=False)
     # both the full bucket (PNG/WebP traffic) and the shrink-on-load bucket
-    # (JPEG traffic) are warmed, per batch size, deduped by (chain, bucket, b)
+    # (JPEG traffic) are warmed, per batch size, deduped by (chain, bucket, b);
+    # when the native raw codec is present the packed-YUV420 transport chain
+    # warms alongside each RGB chain
+    from imaginary_tpu import codecs
+
     shrink = choose_decode_shrink("resize", ImageOptions(width=24), 64, 96, 0, 3)
     expected_dims = {(64, 96), ((64 + shrink - 1) // shrink, (96 + shrink - 1) // shrink)}
-    assert n == 2 * len(expected_dims)
+    transports = 2 if codecs.yuv420_supported() else 1
+    assert n == 2 * len(expected_dims) * transports
     assert chain_mod.cache_size() >= before  # programs landed in the cache
 
 
@@ -33,10 +38,13 @@ def test_prewarm_env_override(monkeypatch):
     monkeypatch.setattr(
         prewarm, "_COMMON", [("resize", ImageOptions(width=16), (32, 48))]
     )
+    from imaginary_tpu import codecs
+
     shrink = choose_decode_shrink("resize", ImageOptions(width=16), 32, 48, 0, 3)
     dims = {(32, 48), ((32 + shrink - 1) // shrink, (48 + shrink - 1) // shrink)}
+    transports = 2 if codecs.yuv420_supported() else 1
     monkeypatch.setenv("IMAGINARY_TPU_PREWARM_BATCHES", "1")
-    assert prewarm.prewarm_common_chains(verbose=False) == len(dims)
+    assert prewarm.prewarm_common_chains(verbose=False) == len(dims) * transports
 
 
 def test_prewarm_bad_env_degrades(monkeypatch):

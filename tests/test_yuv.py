@@ -1,0 +1,221 @@
+"""Packed-YUV420 transport tests: native raw codec entry points, the
+device unpack/pack stages, transport plan wiring, spill-path plane
+execution, and end-to-end parity with the RGB path.
+
+The transport ships JPEG's native subsampled planes across the
+host<->device link (half the bytes of RGB each way) and runs the color
+math on device; these tests pin its quality floor against the RGB path
+and its dimension semantics against the same oracles the RGB path uses.
+"""
+
+import json
+from io import BytesIO
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu import codecs, pipeline
+from imaginary_tpu.options import ImageOptions
+
+yuv_native = pytest.mark.skipif(
+    not codecs.yuv420_supported(), reason="native YUV420 codec not built"
+)
+
+
+def _jpeg_420(w=640, h=360, quality=85) -> bytes:
+    rng = np.random.default_rng(11)
+    yy, xx = np.mgrid[0:h, 0:w]
+    img = np.stack(
+        [
+            (xx * 255 / max(w - 1, 1)).astype(np.uint8),
+            (yy * 255 / max(h - 1, 1)).astype(np.uint8),
+            ((xx + yy) % 256).astype(np.uint8),
+        ],
+        axis=-1,
+    )
+    img[h // 4 : h // 2, w // 4 : w // 2] = rng.integers(0, 256, 3)
+    out = BytesIO()
+    # PIL subsampling=2 is 4:2:0, the dominant camera/web default
+    Image.fromarray(img).save(out, "JPEG", quality=quality, subsampling=2)
+    return out.getvalue()
+
+
+def _psnr(a, b) -> float:
+    mse = np.mean((np.asarray(a, float) - np.asarray(b, float)) ** 2)
+    return 10 * np.log10(255.0**2 / max(mse, 1e-9))
+
+
+@yuv_native
+class TestNativeRawCodec:
+    def test_probe_reports_subsampling(self):
+        meta = codecs.probe_fast(_jpeg_420())
+        assert meta.subsampling == "420"
+
+    def test_decode_roundtrips_against_pil(self):
+        buf = _jpeg_420()
+        from imaginary_tpu.ops.buckets import bucket_shape
+
+        hb, wb = bucket_shape(360, 640)
+        packed, h, w, _ = codecs.decode_yuv420(buf, 1, hb, wb)
+        assert (h, w) == (360, 640)
+        assert packed.shape == (hb + hb // 2, wb, 1)
+        planes = codecs.YuvPlanes(
+            y=packed[:h, :w, 0],
+            u=packed[hb : hb + (h + 1) // 2, : (w + 1) // 2, 0],
+            v=packed[hb : hb + (h + 1) // 2, wb // 2 : wb // 2 + (w + 1) // 2, 0],
+        )
+        rgb = codecs.yuv_planes_to_rgb(planes)
+        ref = np.asarray(Image.open(BytesIO(buf)).convert("RGB"))
+        assert _psnr(rgb, ref) > 30.0  # chroma upsample choice is the only gap
+
+    def test_decode_shrink_dims_match_contract(self):
+        buf = _jpeg_420(1920, 1080)
+        from imaginary_tpu.ops.buckets import bucket_shape
+
+        for denom in (2, 4, 8):
+            eh, ew = -(-1080 // denom), -(-1920 // denom)
+            hb, wb = bucket_shape(eh, ew)
+            packed, h, w, _ = codecs.decode_yuv420(buf, denom, hb, wb)
+            assert (h, w) == (eh, ew)
+
+    def test_decode_rejects_non_420(self):
+        out = BytesIO()
+        Image.fromarray(np.zeros((64, 64, 3), np.uint8)).save(
+            out, "JPEG", quality=95, subsampling=0  # 4:4:4
+        )
+        with pytest.raises(codecs.CodecError):
+            codecs.decode_yuv420(out.getvalue(), 1, 64, 64)
+
+    def test_encode_roundtrip(self):
+        h, w = 117, 203  # odd dims exercise the ceil chroma geometry
+        rng = np.random.default_rng(3)
+        planes = codecs.YuvPlanes(
+            y=rng.integers(0, 256, (h, w), dtype=np.uint8),
+            u=np.full(((h + 1) // 2, (w + 1) // 2), 100, np.uint8),
+            v=np.full(((h + 1) // 2, (w + 1) // 2), 180, np.uint8),
+        )
+        body = codecs.encode_yuv(planes, codecs.EncodeOptions())
+        im = Image.open(BytesIO(body))
+        assert im.size == (w, h)
+        # chroma survives: decode and check the dominant hue
+        rgb = np.asarray(im.convert("RGB")).astype(np.float32)
+        assert rgb[..., 0].mean() > rgb[..., 2].mean()  # V>128 pushes red
+
+
+@yuv_native
+class TestTransportE2E:
+    def test_resize_matches_rgb_path(self):
+        buf = _jpeg_420()
+        o = ImageOptions(width=300, height=200)
+        out_yuv = pipeline.process_operation("resize", buf, o)
+        out_rgb = _force_rgb(lambda: pipeline.process_operation("resize", buf, o))
+        a = Image.open(BytesIO(out_yuv.body))
+        b = Image.open(BytesIO(out_rgb.body))
+        assert a.size == b.size == (300, 200)
+        assert out_yuv.mime == "image/jpeg"
+        assert _psnr(a.convert("RGB"), b.convert("RGB")) > 28.0
+
+    def test_identity_convert_skips_device(self):
+        buf = _jpeg_420()
+        from imaginary_tpu.ops import chain as chain_mod
+
+        before = chain_mod.cache_size()
+        out = pipeline.process_operation(
+            "convert", buf, ImageOptions(type="jpeg", quality=70)
+        )
+        assert Image.open(BytesIO(out.body)).size == (640, 360)
+        assert chain_mod.cache_size() == before  # no device program compiled
+
+    def test_odd_output_dims(self):
+        buf = _jpeg_420(641, 363)
+        out = pipeline.process_operation("crop", buf, ImageOptions(width=301, height=199))
+        assert Image.open(BytesIO(out.body)).size == (301, 199)
+
+    def test_exif_orientation_through_transport(self):
+        # orientation 6 (rotate 90 CW to display): output dims swap
+        base = _jpeg_420(640, 360)
+        im = Image.open(BytesIO(base))
+        out = BytesIO()
+        exif = Image.Exif()
+        exif[274] = 6
+        im.save(out, "JPEG", quality=85, subsampling=2, exif=exif.tobytes())
+        buf = out.getvalue()
+        meta = codecs.probe_fast(buf)
+        assert meta.orientation == 6
+        got = pipeline.process_operation("resize", buf, ImageOptions(width=90))
+        w, h = Image.open(BytesIO(got.body)).size
+        assert w == 90 and h == 160  # oriented 360x640 scaled to width 90
+
+    def test_non_jpeg_target_falls_back_to_rgb_transport(self):
+        buf = _jpeg_420()
+        out = pipeline.process_operation(
+            "resize", buf, ImageOptions(width=120, type="png")
+        )
+        assert out.mime == "image/png"
+        assert Image.open(BytesIO(out.body)).size[0] == 120
+
+    def test_pipeline_over_transport(self):
+        buf = _jpeg_420()
+        from imaginary_tpu.params import build_params_from_query
+
+        ops = json.dumps(
+            [
+                {"operation": "resize", "params": {"width": 400}},
+                {"operation": "rotate", "params": {"rotate": 90}},
+            ]
+        )
+        o = build_params_from_query({"operations": ops})
+        out = pipeline.process_pipeline(buf, o)
+        assert Image.open(BytesIO(out.body)).size == (225, 400)
+
+
+def _force_rgb(fn):
+    """Run fn with the YUV gate off (the RGB baseline for parity checks)."""
+    orig = pipeline._yuv_eligible
+    pipeline._yuv_eligible = lambda *a: False
+    try:
+        return fn()
+    finally:
+        pipeline._yuv_eligible = orig
+
+
+@yuv_native
+class TestYuvSpill:
+    def test_host_exec_fast_plane_path(self):
+        from imaginary_tpu.engine import host_exec
+        from imaginary_tpu.ops.buckets import bucket_shape
+        from imaginary_tpu.ops.plan import plan_operation, wrap_plan_yuv420
+
+        buf = _jpeg_420()
+        hb, wb = bucket_shape(360, 640)
+        packed, h, w, _ = codecs.decode_yuv420(buf, 1, hb, wb)
+        plan = plan_operation("resize", ImageOptions(width=300, height=200), h, w, 0, 3)
+        wrapped = wrap_plan_yuv420(plan, h, w)
+        assert host_exec.can_execute(wrapped)
+        out = host_exec.run(packed, wrapped)
+        assert isinstance(out, codecs.YuvPlanes)
+        assert out.y.shape == (200, 300)
+        assert out.u.shape == (100, 150)
+        # encodable and PSNR-close to the device transport result
+        body = codecs.encode_yuv(out, codecs.EncodeOptions())
+        dev = pipeline.process_operation("resize", buf, ImageOptions(width=300, height=200))
+        a = Image.open(BytesIO(body)).convert("RGB")
+        b = Image.open(BytesIO(dev.body)).convert("RGB")
+        assert _psnr(a, b) > 25.0
+
+    def test_host_exec_general_path_blur(self):
+        from imaginary_tpu.engine import host_exec
+        from imaginary_tpu.ops.buckets import bucket_shape
+        from imaginary_tpu.ops.plan import plan_operation, wrap_plan_yuv420
+
+        buf = _jpeg_420()
+        hb, wb = bucket_shape(360, 640)
+        packed, h, w, _ = codecs.decode_yuv420(buf, 1, hb, wb)
+        plan = plan_operation(
+            "resize", ImageOptions(width=200, sigma=1.5), h, w, 0, 3
+        )
+        wrapped = wrap_plan_yuv420(plan, h, w)
+        out = host_exec.run(packed, wrapped)
+        assert isinstance(out, codecs.YuvPlanes)
+        assert out.y.shape == (113, 200)
