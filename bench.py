@@ -56,7 +56,7 @@ def _run_threaded(fn, n_threads: int, duration: float):
 from bench_util import pctl as _pctl  # noqa: E402
 
 
-def bench_ours(buf: bytes, n_threads: int, duration: float):
+def bench_ours(buf: bytes, n_threads: int, duration: float, reps: int = 1):
     from imaginary_tpu import codecs
     from imaginary_tpu.codecs import EncodeOptions
     from imaginary_tpu.engine import Executor, ExecutorConfig
@@ -96,24 +96,30 @@ def bench_ours(buf: bytes, n_threads: int, duration: float):
     from imaginary_tpu.engine.timing import maybe_start_profiler, stop_profiler
 
     profiling = maybe_start_profiler()  # IMAGINARY_TPU_PROFILE_DIR=<dir>
-    TIMES.reset()
     # stats must cover ONLY the timed window (warmup items would inflate
-    # the device-vs-spill split the JSON reports)
+    # the device-vs-spill split the JSON reports). Multiple windows guard
+    # the headline number against one-off GC pauses / link hiccups on the
+    # shared 1-CPU host (VERDICT r3 weak #7): the MEDIAN window is reported.
     from imaginary_tpu.engine.executor import ExecutorStats
 
-    executor.stats = ExecutorStats()
+    windows = []
     try:
-        rate, lats = _run_threaded(one, n_threads, duration)
+        for _ in range(max(1, reps)):
+            TIMES.reset()
+            executor.stats = ExecutorStats()
+            rate, lats = _run_threaded(one, n_threads, duration)
+            windows.append((rate, lats, executor.stats.to_dict(), TIMES.snapshot()))
     finally:
         if profiling:
             stop_profiler()  # flush the trace even when the run errors
-    stats = executor.stats.to_dict()
-    stages = TIMES.snapshot()
     executor.shutdown()
-    return rate, lats, stats, stages
+    windows.sort(key=lambda t: t[0])
+    median = windows[len(windows) // 2]
+    return median + ([round(w[0], 2) for w in windows],)
 
 
-def bench_baseline(buf: bytes, n_threads: int, duration: float) -> float:
+def bench_baseline(buf: bytes, n_threads: int, duration: float,
+                   reps: int = 1) -> tuple:
     import cv2
 
     data = np.frombuffer(buf, np.uint8)
@@ -124,7 +130,9 @@ def bench_baseline(buf: bytes, n_threads: int, duration: float) -> float:
         cv2.imencode(".jpg", r, [int(cv2.IMWRITE_JPEG_QUALITY), 80])
 
     one()
-    return _run_threaded(one, n_threads, duration)[0]
+    rates = sorted(_run_threaded(one, n_threads, duration)[0]
+                   for _ in range(max(1, reps)))
+    return rates[len(rates) // 2], [round(r, 2) for r in rates]
 
 
 def _probe_accelerator(timeout: float = 90.0) -> bool:
@@ -142,7 +150,8 @@ def _probe_accelerator(timeout: float = 90.0) -> bool:
 
 
 def main():
-    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    duration = float(os.environ.get("BENCH_DURATION", "10"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
     cpus = os.cpu_count() or 1
     # closed-loop clients: enough in flight to fill micro-batches (the TPU
     # path's throughput comes from batch-amortizing the device link's fixed
@@ -181,16 +190,19 @@ def main():
 
     buf = _make_1080p_jpeg()
     print(f"[bench] 1080p jpeg = {len(buf)} bytes, threads={n_threads}, "
-          f"duration={duration}s, cpus={cpus}", file=sys.stderr)
+          f"duration={duration}s x {reps} windows (median), cpus={cpus}",
+          file=sys.stderr)
 
-    ours, lats, exec_stats, stages = bench_ours(buf, n_threads, duration)
+    ours, lats, exec_stats, stages, our_reps = bench_ours(
+        buf, n_threads, duration, reps)
 
     import jax
 
     backend = "cpu-fallback" if fallback else jax.default_backend()
-    print(f"[bench] imaginary-tpu: {ours:.2f} req/s on backend={backend} | "
-          f"p50={_pctl(lats, 0.50)}ms p95={_pctl(lats, 0.95)}ms "
-          f"p99={_pctl(lats, 0.99)}ms", file=sys.stderr)
+    print(f"[bench] imaginary-tpu: {ours:.2f} req/s (windows: {our_reps}) on "
+          f"backend={backend} | p50={_pctl(lats, 0.50)}ms "
+          f"p95={_pctl(lats, 0.95)}ms p99={_pctl(lats, 0.99)}ms",
+          file=sys.stderr)
     print(f"[bench] executor: {exec_stats}", file=sys.stderr)
     print(f"[bench] device-path items={exec_stats['items']} "
           f"spilled-to-host={exec_stats['spilled']}", file=sys.stderr)
@@ -199,8 +211,9 @@ def main():
               f"mean={s['mean_ms']:.2f}ms p50={s['p50_ms']:.2f}ms "
               f"p99={s['p99_ms']:.2f}ms", file=sys.stderr)
 
-    base = bench_baseline(buf, n_threads, duration)
-    print(f"[bench] cpu baseline (cv2): {base:.2f} req/s", file=sys.stderr)
+    base, base_reps = bench_baseline(buf, n_threads, duration, reps)
+    print(f"[bench] cpu baseline (cv2): {base:.2f} req/s "
+          f"(windows: {base_reps})", file=sys.stderr)
 
     print(json.dumps({
         "metric": "resize_1080p_jpeg_e2e_throughput",
@@ -212,6 +225,7 @@ def main():
         "spilled_items": exec_stats["spilled"],
         "p50_ms": _pctl(lats, 0.50),
         "p99_ms": _pctl(lats, 0.99),
+        "windows": {"ours": our_reps, "baseline": base_reps},
     }))
 
 

@@ -291,6 +291,15 @@ def process_pipeline(
     if len(o.operations) > MAX_PIPELINE_OPERATIONS:
         raise new_error(f"Maximum pipeline operations ({MAX_PIPELINE_OPERATIONS}) exceeded", 400)
 
+    from imaginary_tpu.imgtype import determine_image_type
+
+    src_type = determine_image_type(buf)
+    if meta is None and src_type is ImageType.JPEG:
+        try:
+            meta = codecs.probe_fast(buf)
+        except ImageError:
+            meta = None  # decode below raises the user-facing error
+
     # Shrink-on-load keyed to the FIRST op: its planner proof guarantees the
     # op's output dims are unchanged at 1/N decode, and every later op sees
     # only that output — so the whole pipeline's geometry is preserved while
@@ -303,15 +312,15 @@ def process_pipeline(
         except Exception:
             shrink = 1
 
-    from imaginary_tpu.imgtype import determine_image_type
-
-    src_type = determine_image_type(buf)
-    if meta is None and src_type is ImageType.JPEG:
-        try:
-            meta = codecs.probe_fast(buf)
-        except ImageError:
-            meta = None
-    if _yuv_eligible(src_type, meta, o):
+    # The packed transport only pays off when the OUTPUT is JPEG too: a
+    # mid-pipeline type switch would add a pointless chroma-subsample
+    # generation and forfeit the raw encoder, so any op requesting a
+    # non-JPEG type keeps the whole request on the RGB path.
+    ops_keep_jpeg = all(
+        (op.params or {}).get("type") in (None, "", "jpeg", "auto")
+        for op in o.operations
+    )
+    if ops_keep_jpeg and _yuv_eligible(src_type, meta, o):
         sh = -(-meta.height // shrink)
         sw = -(-meta.width // shrink)
         got = _decode_yuv_packed(buf, shrink, sh, sw)

@@ -155,6 +155,30 @@ class TestTransportE2E:
         assert out.mime == "image/png"
         assert Image.open(BytesIO(out.body)).size[0] == 120
 
+    def test_pipeline_type_switch_stays_on_rgb_path(self):
+        """A mid-pipeline switch to a non-JPEG type must avoid the packed
+        transport (it would add a chroma-subsample generation for nothing)."""
+        buf = _jpeg_420()
+        from imaginary_tpu.params import build_params_from_query
+        from imaginary_tpu.ops import chain as chain_mod
+
+        ops = json.dumps(
+            [
+                {"operation": "resize", "params": {"width": 160}},
+                {"operation": "convert", "params": {"type": "png"}},
+            ]
+        )
+        o = build_params_from_query({"operations": ops})
+        calls = []
+        orig = pipeline._decode_yuv_packed
+        pipeline._decode_yuv_packed = lambda *a: calls.append(a) or orig(*a)
+        try:
+            out = pipeline.process_pipeline(buf, o)
+        finally:
+            pipeline._decode_yuv_packed = orig
+        assert out.mime == "image/png"
+        assert not calls  # the YUV transport was never attempted
+
     def test_pipeline_over_transport(self):
         buf = _jpeg_420()
         from imaginary_tpu.params import build_params_from_query
