@@ -1,6 +1,5 @@
 #!/usr/bin/env python
-"""Device-path microbenchmark: where does on-chip time go, and does the
-Pallas fused-resample beat the einsum path? (VERDICT r1 next #1/#3.)
+"""Device-path microbenchmark: where does on-chip time go?
 
 Per bucket (1080p full, 1080p-shrunk, 4K) and per batch size this measures,
 with warm compile caches:
@@ -13,7 +12,8 @@ with warm compile caches:
   tflops/mfu   achieved matmul throughput of the resample einsums, vs the
                chip's bf16 peak (PEAK_TFLOPS env, default 197 = v5e)
 
-plus an einsum-vs-Pallas A/B on the same chain when the backend is TPU.
+(The einsum-vs-Pallas A/B this harness used to carry is settled — see the
+note above main(); the r4 artifact records the losing Pallas numbers.)
 
 Usage: python bench_device.py            (probes the accelerator; refuses
                                           to silently substitute CPU)
@@ -140,81 +140,12 @@ def bench_chain(name, in_h, in_w, out_h, out_w, batches=(1, 8, 16, 32, 64)):
     return results
 
 
-def bench_pallas_ab(in_h, in_w, out_h, out_w, bs=16):
-    """Same resample through the einsum chain vs the fused Pallas kernel."""
-    import jax
-    import jax.numpy as jnp
-
-    from imaginary_tpu.ops import pallas_kernels as pk
-
-    rng = np.random.default_rng(1)
-    x = jnp.asarray(rng.integers(0, 256, (bs, in_h, in_w, 3)).astype(np.float32))
-    src_h = jnp.full((bs,), float(in_h))
-    dst_h = jnp.full((bs,), float(out_h))
-    src_w = jnp.full((bs,), float(in_w))
-    dst_w = jnp.full((bs,), float(out_w))
-
-    on_tpu = jax.default_backend() == "tpu"
-    y = pk.resample_2d(x, src_h, dst_h, src_w, dst_w, out_h, out_w,
-                       interpret=not on_tpu)
-    y.block_until_ready()
-    ts = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        y = pk.resample_2d(x, src_h, dst_h, src_w, dst_w, out_h, out_w,
-                           interpret=not on_tpu)
-        y.block_until_ready()
-        ts.append((time.perf_counter() - t0) * 1000)
-    pallas_ms = _med(ts)
-
-    # einsum equivalent (the stages.py path): batched sampling matrices
-    def einsum_resample(x, src_h, dst_h, src_w, dst_w):
-        def weights(out_size, in_size, src, dst):
-            y = jnp.arange(out_size, dtype=jnp.float32)[None, :, None]
-            k = jnp.arange(in_size, dtype=jnp.float32)[None, None, :]
-            scale = dst[:, None, None] / src[:, None, None]
-            centre = (y + 0.5) / scale - 0.5
-            stretch = jnp.maximum(1.0, 1.0 / scale)
-            d = (k - centre) / stretch
-            w = jnp.where(jnp.abs(d) < 3.0, jnp.sinc(d) * jnp.sinc(d / 3.0), 0.0)
-            w = jnp.where((k < src[:, None, None]) & (y < dst[:, None, None]), w, 0.0)
-            n = jnp.sum(w, axis=-1, keepdims=True)
-            return jnp.where(n > 1e-6, w / jnp.maximum(n, 1e-6), 0.0)
-
-        wh = weights(out_h, x.shape[1], src_h, dst_h)
-        t = jnp.einsum("boi,bihc->bohc", wh, x)
-        ww = weights(out_w, x.shape[2], src_w, dst_w)
-        return jnp.einsum("boi,bhic->bhoc", ww, t)
-
-    f = jax.jit(einsum_resample)
-    y2 = f(x, src_h, dst_h, src_w, dst_w)
-    y2.block_until_ready()
-    ts = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        y2 = f(x, src_h, dst_h, src_w, dst_w)
-        y2.block_until_ready()
-        ts.append((time.perf_counter() - t0) * 1000)
-    einsum_ms = _med(ts)
-
-    err = float(jnp.max(jnp.abs(y - y2)))
-    flops = resample_flops(in_h, in_w, out_h, out_w) * bs
-    row = {
-        "metric": f"pallas_vs_einsum_{in_h}x{in_w}to{out_h}x{out_w}",
-        "batch": bs,
-        "backend": jax.default_backend(),
-        "pallas_interpret": not on_tpu,
-        "pallas_ms": round(pallas_ms, 3),
-        "einsum_ms": round(einsum_ms, 3),
-        "speedup": round(einsum_ms / pallas_ms, 3) if pallas_ms > 0 else 0,
-        "max_abs_err": round(err, 4),
-        "pallas_tflops": round(flops / (pallas_ms / 1000) / 1e12, 3),
-        "einsum_tflops": round(flops / (einsum_ms / 1000) / 1e12, 3),
-    }
-    log(f"[dev] pallas A/B {row['metric']}: pallas={pallas_ms:.2f}ms "
-        f"einsum={einsum_ms:.2f}ms speedup={row['speedup']}x err={err:.3f}")
-    print(json.dumps(row), flush=True)
-    return row
+# The Pallas-vs-einsum A/B that used to live here is SETTLED: the r4 run on
+# the real chip (artifacts/bench_device_r04_tpu.jsonl, pallas_vs_einsum rows)
+# measured the fused Pallas resample 4.7x slower than the sampling-matrix
+# einsums at the serving bucket and no better at full 1080p, so the Pallas
+# module was deleted per the r3 verdict (weak #3: "flip the default on a win
+# or delete on a loss"). The einsum path in ops/stages.py carries the note.
 
 
 def main():
@@ -239,17 +170,12 @@ def main():
         # quick CPU smoke: tiny shapes only (full buckets take minutes/rep
         # on a 1-CPU host; the real run happens on the chip)
         bench_chain("smoke", 128, 160, 64, 80, batches=(1, 8))
-        bench_pallas_ab(128, 160, 64, 80, bs=2)
         return 0
 
     # the three serving buckets: full 1080p, its 1/4 shrink, 4K
     bench_chain("1080p", 1080, 1920, 200, 300)
     bench_chain("1080p_shrink4", 270, 480, 200, 300, batches=(1, 16, 64))
     bench_chain("4k", 2160, 3840, 480, 854, batches=(1, 8, 16))
-
-    # Pallas A/B at the shrink bucket (the real serving shape) and full
-    bench_pallas_ab(270, 480, 200, 300)
-    bench_pallas_ab(1080, 1920, 200, 300, bs=4)
     return 0
 
 

@@ -146,6 +146,119 @@ def yuv_planes_to_rgb(p: YuvPlanes) -> np.ndarray:
     return np.clip(np.stack([r, g, b], axis=-1) + 0.5, 0, 255).astype(np.uint8)
 
 
+# --- JPEG metadata carry-through (ref: options.go:139 StripMetadata) ---------
+#
+# libvips preserves EXIF/ICC unless StripMetadata is set, and the reference
+# defaults stripmeta to false. Our encoders write clean JPEGs, so metadata
+# preservation is a byte-level splice: lift the source's APP1(Exif)/APP2(ICC)
+# segments and re-insert them into the encoded output. Orientation is reset
+# to 1 when the pipeline applied the EXIF rotation (otherwise viewers would
+# rotate twice) — the same normalization libvips autorotate performs.
+
+
+def jpeg_metadata_segments(buf: bytes) -> list:
+    """Raw APP1(Exif) + APP2(ICC_PROFILE) segments of a JPEG, marker included."""
+    segs: list = []
+    if len(buf) < 4 or buf[0] != 0xFF or buf[1] != 0xD8:
+        return segs
+    i = 2
+    while i + 4 <= len(buf):
+        if buf[i] != 0xFF:
+            break
+        # ISO 10918-1 B.1.1.2: any number of 0xFF fill bytes may precede a
+        # marker — skip them or the length read lands on the marker byte
+        while i + 4 <= len(buf) and buf[i + 1] == 0xFF:
+            i += 1
+        if i + 4 > len(buf):
+            break
+        marker = buf[i + 1]
+        if marker == 0xD8 or 0xD0 <= marker <= 0xD9:
+            i += 2
+            continue
+        seglen = (buf[i + 2] << 8) | buf[i + 3]
+        if seglen < 2 or i + 2 + seglen > len(buf):
+            break
+        if marker == 0xE1 and buf[i + 4 : i + 10] == b"Exif\x00\x00":
+            segs.append(bytes(buf[i : i + 2 + seglen]))
+        elif marker == 0xE2 and buf[i + 4 : i + 16] == b"ICC_PROFILE\x00":
+            segs.append(bytes(buf[i : i + 2 + seglen]))
+        if marker == 0xDA:
+            break
+        i += 2 + seglen
+    return segs
+
+
+def patch_exif_segment(seg: bytes, orientation: Optional[int] = None,
+                       pixel_w: Optional[int] = None,
+                       pixel_h: Optional[int] = None) -> bytes:
+    """Rewrite in-place EXIF tags so carried metadata describes the OUTPUT:
+    IFD0 Orientation (0x0112), and the Exif sub-IFD's PixelXDimension
+    (0xA002) / PixelYDimension (0xA003) — libvips re-syncs the same fields
+    on save. None leaves a field untouched; missing tags are skipped."""
+    # segment: FF E1 len 'Exif\0\0' TIFF...
+    t = 10  # TIFF header offset within the segment
+    if len(seg) < t + 8:
+        return seg
+    le = seg[t : t + 2] == b"II"
+    if not le and seg[t : t + 2] != b"MM":
+        return seg
+    endian = "little" if le else "big"
+
+    def rd16(o):
+        return int.from_bytes(seg[o : o + 2], endian)
+
+    def rd32(o):
+        return int.from_bytes(seg[o : o + 4], endian)
+
+    out = bytearray(seg)
+
+    def write_value(off, value):
+        # entry: tag(2) type(2) count(4) value(4); SHORT(3) and LONG(4)
+        # values of count 1 sit left-justified in the value field
+        typ = rd16(off + 2)
+        if typ == 3:
+            out[off + 8 : off + 10] = value.to_bytes(2, endian)
+        elif typ == 4:
+            out[off + 8 : off + 12] = value.to_bytes(4, endian)
+
+    def walk(ifd, wanted):
+        """Patch wanted tags in one IFD; returns the Exif sub-IFD offset."""
+        sub = None
+        if ifd + 2 > len(seg):
+            return None
+        n = rd16(ifd)
+        for e in range(n):
+            off = ifd + 2 + 12 * e
+            if off + 12 > len(seg):
+                return sub
+            tag = rd16(off)
+            if tag in wanted and wanted[tag] is not None:
+                write_value(off, wanted[tag])
+            if tag == 0x8769:  # ExifIFD pointer
+                sub = t + rd32(off + 8)
+        return sub
+
+    sub_ifd = walk(t + rd32(t + 4), {0x0112: orientation})
+    if sub_ifd is not None and (pixel_w is not None or pixel_h is not None):
+        walk(sub_ifd, {0xA002: pixel_w, 0xA003: pixel_h})
+    return bytes(out)
+
+
+def reset_exif_orientation(seg: bytes) -> bytes:
+    """APP1 segment with IFD0 Orientation forced to 1 (see patch_exif_segment)."""
+    return patch_exif_segment(seg, orientation=1)
+
+
+def insert_jpeg_segments(jpeg: bytes, segs: list) -> bytes:
+    """Splice metadata segments into a JPEG after SOI (and any APP0/JFIF)."""
+    if not segs or len(jpeg) < 4 or jpeg[0] != 0xFF or jpeg[1] != 0xD8:
+        return jpeg
+    i = 2
+    while i + 4 <= len(jpeg) and jpeg[i] == 0xFF and jpeg[i + 1] == 0xE0:
+        i += 2 + ((jpeg[i + 2] << 8) | jpeg[i + 3])
+    return jpeg[:i] + b"".join(segs) + jpeg[i:]
+
+
 def yuv420_supported() -> bool:
     """True when the active backend is the native extension with the
     packed-YUV420 transport entry points."""

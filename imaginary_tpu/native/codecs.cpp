@@ -55,6 +55,9 @@ int exif_orientation(const uint8_t* buf, size_t len) {
   size_t i = 2;
   while (i + 4 <= len) {
     if (buf[i] != 0xFF) break;
+    // skip 0xFF fill bytes before the marker (ISO 10918-1 B.1.1.2)
+    while (i + 4 <= len && buf[i + 1] == 0xFF) i++;
+    if (i + 4 > len) break;
     uint8_t marker = buf[i + 1];
     if (marker == 0xD8 || (marker >= 0xD0 && marker <= 0xD9)) { i += 2; continue; }
     size_t seglen = ((size_t)buf[i + 2] << 8) | buf[i + 3];

@@ -100,24 +100,18 @@ class SampleSpec:
     kernel: str = "lanczos3"
 
     def apply(self, x, h, w, dyn):
-        from imaginary_tpu.ops.pallas_kernels import use_pallas
-
-        if use_pallas():
-            from imaginary_tpu.ops.pallas_kernels import resample_2d
-
-            out = resample_2d(
-                x, h.astype(jnp.float32), dyn["dst_h"],
-                w.astype(jnp.float32), dyn["dst_w"],
-                self.out_hb, self.out_wb, self.kernel,
-            )
-        else:
-            mm = _mm_dtype()
-            wy = sample_matrix(self.out_hb, x.shape[1], h.astype(jnp.float32), dyn["dst_h"], self.kernel)
-            t = jnp.einsum("byk,bkwc->bywc", wy.astype(mm), x.astype(mm),
-                           preferred_element_type=jnp.float32)
-            wx = sample_matrix(self.out_wb, x.shape[2], w.astype(jnp.float32), dyn["dst_w"], self.kernel)
-            out = jnp.einsum("bxw,bywc->byxc", wx.astype(mm), t.astype(mm),
-                             preferred_element_type=jnp.float32)
+        # Sampling-matrix einsums, deliberately NOT a hand-written kernel:
+        # the r4 hardware A/B (artifacts/bench_device_r04_tpu.jsonl,
+        # pallas_vs_einsum rows) measured a fused Pallas resample at 4.7x
+        # SLOWER than these einsums at the serving bucket — XLA already
+        # feeds the MXU optimally here, so the Pallas module was deleted.
+        mm = _mm_dtype()
+        wy = sample_matrix(self.out_hb, x.shape[1], h.astype(jnp.float32), dyn["dst_h"], self.kernel)
+        t = jnp.einsum("byk,bkwc->bywc", wy.astype(mm), x.astype(mm),
+                       preferred_element_type=jnp.float32)
+        wx = sample_matrix(self.out_wb, x.shape[2], w.astype(jnp.float32), dyn["dst_w"], self.kernel)
+        out = jnp.einsum("bxw,bywc->byxc", wx.astype(mm), t.astype(mm),
+                         preferred_element_type=jnp.float32)
         return out, dyn["dst_h"].astype(jnp.int32), dyn["dst_w"].astype(jnp.int32)
 
 

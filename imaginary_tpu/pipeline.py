@@ -43,6 +43,11 @@ ALL_OPERATIONS = OPERATION_NAMES + ("info", "pipeline")
 
 MAX_PIPELINE_OPERATIONS = 10  # ref: image.go:383-385
 
+# Type values under which a request's output stays JPEG (imgtype.py maps the
+# "jpg" alias; "" and "auto" inherit a JPEG source) — the packed-YUV420
+# transport gate.
+_JPEG_TYPE_NAMES = ("", "jpeg", "jpg", "auto")
+
 # Injected by the web layer: url -> RGBA ndarray (watermarkimage fetch,
 # image.go:343-370). Kept injectable so the ops layer stays network-free.
 WatermarkFetcher = Callable[[str], np.ndarray]
@@ -106,6 +111,34 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
             raise
     TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
     return ProcessedImage(body=body, mime=get_image_mime_type(actual))
+
+
+def _carry_metadata(src_buf: bytes, strip: bool, out: ProcessedImage,
+                    orientation_applied: bool, out_w: int = 0,
+                    out_h: int = 0) -> ProcessedImage:
+    """Preserve source EXIF/ICC on JPEG output unless stripmeta is set
+    (ref: options.go:139 — StripMetadata defaults false; libvips keeps
+    metadata). Orientation resets to 1 when the chain already applied the
+    EXIF rotation, and PixelX/YDimension re-sync to the output geometry —
+    both exactly as libvips does on save."""
+    if strip or out.mime != "image/jpeg":
+        return out
+    segs = codecs.jpeg_metadata_segments(src_buf)
+    if not segs:
+        return out
+    segs = [
+        codecs.patch_exif_segment(
+            s,
+            orientation=1 if orientation_applied else None,
+            pixel_w=out_w or None,
+            pixel_h=out_h or None,
+        )
+        if s[4:10] == b"Exif\x00\x00" else s
+        for s in segs
+    ]
+    return ProcessedImage(
+        body=codecs.insert_jpeg_segments(out.body, segs), mime=out.mime
+    )
 
 
 def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
@@ -185,6 +218,8 @@ def process_operation(
     )
     arr = _run_stages(d.array, plan, runner)
     out = _encode(arr, o, _encode_type(o, d.type))
+    out = _carry_metadata(buf, o.strip_metadata, out, not o.no_rotation,
+                          plan.out_w, plan.out_h)
     TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
     return out
 
@@ -196,7 +231,7 @@ def _yuv_eligible(src_type, meta, o: ImageOptions) -> bool:
         return False
     if meta.subsampling != "420":
         return False
-    if o.type not in ("", "jpeg", "auto"):
+    if o.type not in _JPEG_TYPE_NAMES:
         return False
     try:
         return codecs.yuv420_supported()
@@ -245,10 +280,13 @@ def _process_yuv420(name, buf, o, meta, shrink, watermark_fetcher, runner,
 
         note_placement("device")
         planes = codecs.unpack_planes(packed, sh, sw, hb, wb)
-        return _encode(planes, o, _encode_type(o, ImageType.JPEG))
-    wrapped = wrap_plan_yuv420(plan, sh, sw)
-    result = _run_stages(packed, wrapped, runner)
-    return _encode(result, o, _encode_type(o, ImageType.JPEG))
+        out = _encode(planes, o, _encode_type(o, ImageType.JPEG))
+    else:
+        wrapped = wrap_plan_yuv420(plan, sh, sw)
+        result = _run_stages(packed, wrapped, runner)
+        out = _encode(result, o, _encode_type(o, ImageType.JPEG))
+    return _carry_metadata(buf, o.strip_metadata, out, not o.no_rotation,
+                           plan.out_w, plan.out_h)
 
 
 def _pick_shrink(name: str, buf: bytes, o: ImageOptions, meta=None) -> int:
@@ -317,7 +355,7 @@ def process_pipeline(
     # generation and forfeit the raw encoder, so any op requesting a
     # non-JPEG type keeps the whole request on the RGB path.
     ops_keep_jpeg = all(
-        (op.params or {}).get("type") in (None, "", "jpeg", "auto")
+        (op.params or {}).get("type") in (None,) + _JPEG_TYPE_NAMES
         for op in o.operations
     )
     if ops_keep_jpeg and _yuv_eligible(src_type, meta, o):
@@ -326,7 +364,7 @@ def process_pipeline(
         got = _decode_yuv_packed(buf, shrink, sh, sw)
         if got is not None:
             packed, hb, wb = got
-            combined, final_o, target = _build_pipeline_plan(
+            combined, final_o, target, rotated, strip = _build_pipeline_plan(
                 o, sh, sw, meta.orientation, 3, ImageType.JPEG, watermark_fetcher
             )
             if not combined.stages:
@@ -334,27 +372,44 @@ def process_pipeline(
 
                 note_placement("device")
                 planes = codecs.unpack_planes(packed, sh, sw, hb, wb)
-                return _encode(planes, final_o, target)
-            wrapped = wrap_plan_yuv420(combined, sh, sw)
-            result = _run_stages(packed, wrapped, runner)
-            return _encode(result, final_o, target)
+                out = _encode(planes, final_o, target)
+            else:
+                wrapped = wrap_plan_yuv420(combined, sh, sw)
+                result = _run_stages(packed, wrapped, runner)
+                out = _encode(result, final_o, target)
+            return _carry_metadata(buf, strip, out, rotated,
+                                   combined.out_w, combined.out_h)
 
     d = codecs.decode(buf, shrink)
-    combined, final_o, target = _build_pipeline_plan(
+    combined, final_o, target, rotated, strip = _build_pipeline_plan(
         o, d.array.shape[0], d.array.shape[1], d.orientation,
         d.array.shape[2], d.type, watermark_fetcher,
     )
     arr = _run_stages(d.array, combined, runner)
-    return _encode(arr, final_o, target)
+    out = _encode(arr, final_o, target)
+    return _carry_metadata(buf, strip, out, rotated,
+                           combined.out_w, combined.out_h)
 
 
 def _build_pipeline_plan(o, cur_h, cur_w, orientation, channels, src_type,
                          watermark_fetcher):
     """Concatenate every op's stages into one combined plan (pure host
-    math — no pixels needed, so both transports share it)."""
+    math — no pixels needed, so both transports share it).
+
+    Also reports whether the EXIF rotation was actually APPLIED by the
+    chain: the first successfully-planned op consumes the orientation, and
+    only when its own no_rotation is unset does it plan the rotate stages —
+    the metadata carry must reset the Orientation tag exactly when the
+    pixels were rotated, no more, no less.
+    """
     stages: list = []
     final_o = o
     target = _encode_type(o, src_type)
+    orientation_applied = False
+    # stripmeta on ANY op (or top-level) strips: the reference re-encodes
+    # per op, so a mid-chain StripMetadata permanently removes metadata —
+    # and an explicit strip request must never leak EXIF/GPS
+    strip = o.strip_metadata
     for i, op in enumerate(o.operations):
         if op.name not in OPERATION_NAMES:  # info/pipeline are not nestable
             raise new_error(f"Unsupported operation: {op.name}", 400)
@@ -371,13 +426,17 @@ def _build_pipeline_plan(o, cur_h, cur_w, orientation, channels, src_type,
             if op.ignore_failure:
                 continue
             raise
+        if orientation > 1 and not op_opts.no_rotation:
+            orientation_applied = True
+        strip = strip or op_opts.strip_metadata
         stages.extend(plan.stages)
         cur_h, cur_w = plan.out_h, plan.out_w
         orientation = 0  # EXIF applies once; later ops see upright pixels
         final_o = op_opts
         if op_opts.type:
             target = _encode_type(op_opts, src_type)
-    return ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w), final_o, target
+    return (ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w), final_o,
+            target, orientation_applied, strip)
 
 
 def _fetch_watermark(name, o, fetcher) -> Optional[np.ndarray]:

@@ -1,0 +1,200 @@
+"""stripmeta semantics (ref: options.go:139 StripMetadata, default false):
+EXIF and ICC survive processing unless stripmeta=true, with Orientation
+normalized to 1 once the chain has applied the rotation — libvips
+autorotate behavior, now matched by the byte-splice carry in pipeline."""
+
+from io import BytesIO
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu import codecs, pipeline
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.params import build_params_from_query
+
+# a tiny but structurally valid ICC profile: PIL accepts any bytes tagged
+# icc_profile; real readers only need the segment to round-trip intact
+FAKE_ICC = b"\x00\x00\x02\x00" + b"ADBE" + b"\x00" * 120
+
+
+def _jpeg_with_metadata(orientation=6, w=320, h=240) -> bytes:
+    rng = np.random.default_rng(5)
+    img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    exif = Image.Exif()
+    exif[274] = orientation  # Orientation
+    exif[271] = "imaginary-tpu-test"  # Make
+    out = BytesIO()
+    Image.fromarray(img).save(
+        out, "JPEG", quality=85, subsampling=2,
+        exif=exif.tobytes(), icc_profile=FAKE_ICC,
+    )
+    return out.getvalue()
+
+
+def _read_meta(body: bytes):
+    im = Image.open(BytesIO(body))
+    exif = im.getexif()
+    return dict(exif), im.info.get("icc_profile")
+
+
+class TestSegmentHelpers:
+    def test_extract_finds_exif_and_icc(self):
+        segs = codecs.jpeg_metadata_segments(_jpeg_with_metadata())
+        kinds = {s[4:10] for s in segs}
+        assert any(k == b"Exif\x00\x00" for k in kinds)
+        assert any(s[4:16] == b"ICC_PROFILE\x00" for s in segs)
+
+    def test_no_metadata_yields_empty(self):
+        out = BytesIO()
+        Image.fromarray(np.zeros((32, 32, 3), np.uint8)).save(out, "JPEG")
+        assert codecs.jpeg_metadata_segments(out.getvalue()) == []
+
+    def test_reset_orientation(self):
+        segs = codecs.jpeg_metadata_segments(_jpeg_with_metadata(orientation=6))
+        exif_seg = next(s for s in segs if s[4:10] == b"Exif\x00\x00")
+        patched = codecs.reset_exif_orientation(exif_seg)
+        assert patched != exif_seg
+        # re-wrap into a minimal JPEG so PIL can parse the patched segment
+        out = BytesIO()
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(out, "JPEG")
+        jpg = codecs.insert_jpeg_segments(out.getvalue(), [patched])
+        exif, _ = _read_meta(jpg)
+        assert exif[274] == 1
+        assert exif[271] == "imaginary-tpu-test"  # other tags untouched
+
+
+class TestCarryThrough:
+    def test_default_preserves_exif_and_icc_with_orientation_reset(self):
+        buf = _jpeg_with_metadata(orientation=6)
+        out = pipeline.process_operation("resize", buf, ImageOptions(width=100))
+        exif, icc = _read_meta(out.body)
+        assert exif.get(271) == "imaginary-tpu-test"
+        assert exif.get(274) == 1  # rotation was applied, tag normalized
+        assert icc == FAKE_ICC
+        # the pixels really were rotated: 320x240 oriented -> 240x320 source
+        im = Image.open(BytesIO(out.body))
+        assert im.size == (100, 133)
+
+    def test_stripmeta_true_strips(self):
+        buf = _jpeg_with_metadata()
+        o = build_params_from_query({"width": "100", "stripmeta": "true"})
+        out = pipeline.process_operation("resize", buf, o)
+        exif, icc = _read_meta(out.body)
+        assert 271 not in exif
+        assert icc is None
+
+    def test_norotation_keeps_original_orientation_tag(self):
+        buf = _jpeg_with_metadata(orientation=6)
+        o = build_params_from_query({"width": "100", "norotation": "true"})
+        out = pipeline.process_operation("resize", buf, o)
+        exif, _ = _read_meta(out.body)
+        assert exif.get(274) == 6  # pixels unrotated, tag kept faithful
+
+    def test_rgb_path_also_carries(self):
+        # PNG output never carries JPEG segments; JPEG output via the RGB
+        # transport (force with a 4:4:4 source) still does
+        rng = np.random.default_rng(6)
+        img = rng.integers(0, 256, (120, 160, 3), dtype=np.uint8)
+        exif = Image.Exif()
+        exif[271] = "imaginary-tpu-test"
+        out = BytesIO()
+        Image.fromarray(img).save(out, "JPEG", quality=90, subsampling=0,
+                                  exif=exif.tobytes())
+        buf = out.getvalue()
+        got = pipeline.process_operation("resize", buf, ImageOptions(width=80))
+        ex, _ = _read_meta(got.body)
+        assert ex.get(271) == "imaginary-tpu-test"
+
+    def test_pipeline_route_carries(self):
+        import json
+
+        buf = _jpeg_with_metadata(orientation=1)
+        o = build_params_from_query({"operations": json.dumps(
+            [{"operation": "resize", "params": {"width": 90}}]
+        )})
+        out = pipeline.process_pipeline(buf, o)
+        exif, icc = _read_meta(out.body)
+        assert exif.get(271) == "imaginary-tpu-test"
+        assert icc == FAKE_ICC
+
+    def test_pipeline_top_level_stripmeta_wins(self):
+        """?stripmeta=true on /pipeline must strip even though per-op
+        options default strip_metadata to false (privacy: explicit strip
+        requests can never leak EXIF)."""
+        import json
+
+        buf = _jpeg_with_metadata()
+        o = build_params_from_query({
+            "stripmeta": "true",
+            "operations": json.dumps(
+                [{"operation": "resize", "params": {"width": 90}}]
+            ),
+        })
+        out = pipeline.process_pipeline(buf, o)
+        exif, icc = _read_meta(out.body)
+        assert 271 not in exif
+        assert icc is None
+
+    def test_pipeline_mid_chain_stripmeta_strips(self):
+        """stripmeta on ANY pipeline op strips: the reference re-encodes per
+        op, so a mid-chain StripMetadata permanently removes metadata even
+        when later ops don't set it."""
+        import json
+
+        buf = _jpeg_with_metadata()
+        o = build_params_from_query({"operations": json.dumps([
+            {"operation": "resize", "params": {"width": 100, "stripmeta": "true"}},
+            {"operation": "flip", "params": {}},
+        ])})
+        out = pipeline.process_pipeline(buf, o)
+        exif, icc = _read_meta(out.body)
+        assert 271 not in exif
+        assert icc is None
+
+    def test_fill_bytes_before_marker_still_found(self):
+        """ISO 10918-1 B.1.1.2 allows 0xFF fill bytes before any marker;
+        the segment scanner must skip them, not abort the scan."""
+        buf = _jpeg_with_metadata()
+        # inject two fill bytes right after SOI
+        padded = buf[:2] + b"\xff\xff" + buf[2:]
+        segs = codecs.jpeg_metadata_segments(padded)
+        assert any(s[4:10] == b"Exif\x00\x00" for s in segs)
+
+    def test_exif_pixel_dimensions_resync_to_output(self):
+        """PixelX/YDimension in the carried EXIF must describe the OUTPUT
+        geometry (libvips re-syncs them on save)."""
+        rng = np.random.default_rng(9)
+        img = rng.integers(0, 256, (240, 320, 3), dtype=np.uint8)
+        exif = Image.Exif()
+        exif[271] = "imaginary-tpu-test"
+        # write ExifIFD dimension tags describing the source
+        ifd = exif.get_ifd(0x8769)
+        ifd[0xA002] = 320
+        ifd[0xA003] = 240
+        out = BytesIO()
+        Image.fromarray(img).save(out, "JPEG", quality=85, subsampling=2,
+                                  exif=exif.tobytes())
+        got = pipeline.process_operation(
+            "resize", out.getvalue(), ImageOptions(width=100)
+        )
+        im = Image.open(BytesIO(got.body))
+        sub = im.getexif().get_ifd(0x8769)
+        assert im.size == (100, 75)
+        assert sub.get(0xA002) == 100
+        assert sub.get(0xA003) == 75
+
+    def test_pipeline_norotation_first_op_keeps_orientation_tag(self):
+        """When the FIRST op sets norotation, the chain never rotates the
+        pixels (orientation is consumed once), so the carried Orientation
+        tag must stay faithful — even if later ops don't set norotation."""
+        import json
+
+        buf = _jpeg_with_metadata(orientation=6)
+        o = build_params_from_query({"operations": json.dumps([
+            {"operation": "resize", "params": {"width": 100, "norotation": "true"}},
+            {"operation": "flip", "params": {}},
+        ])})
+        out = pipeline.process_pipeline(buf, o)
+        exif, _ = _read_meta(out.body)
+        assert exif.get(274) == 6

@@ -160,7 +160,6 @@ class TestTransportE2E:
         transport (it would add a chroma-subsample generation for nothing)."""
         buf = _jpeg_420()
         from imaginary_tpu.params import build_params_from_query
-        from imaginary_tpu.ops import chain as chain_mod
 
         ops = json.dumps(
             [
