@@ -153,8 +153,8 @@ class TestSpillPolicy:
 
         ex = Executor(ExecutorConfig(window_ms=1, probe_interval=10**9, host_spill=True))
         # force the cost model into "spill everything" territory
-        ex._device_item_ms = 1000.0
-        ex._host_item_ms = 0.01
+        ex._device_ms_per_mb = 10000.0
+        ex._host_ms_per_mpix = 0.01
         monkeypatch.setattr(
             ex_mod.host_exec, "run",
             lambda arr, plan: (_ for _ in ()).throw(RuntimeError("edge case")),
@@ -167,8 +167,8 @@ class TestSpillPolicy:
 
     def test_successful_spill_counts(self):
         ex = Executor(ExecutorConfig(window_ms=1, probe_interval=10**9, host_spill=True))
-        ex._device_item_ms = 1000.0
-        ex._host_item_ms = 0.01
+        ex._device_ms_per_mb = 10000.0
+        ex._host_ms_per_mpix = 0.01
         out = ex.process(_img(100, 80), _resize_plan(100, 80, 40))
         assert out.shape == (50, 40, 3)
         assert ex.stats.spilled == 1
@@ -177,7 +177,7 @@ class TestSpillPolicy:
 
     def test_cold_compile_does_not_seed_cost_model(self):
         """The first drain of a never-seen chain signature pays XLA compile;
-        that sample must not enter device_item_ms (ADVICE r1 medium #1)."""
+        that sample must not enter device_ms_per_mb (ADVICE r1 medium #1)."""
         from imaginary_tpu.ops import chain as chain_mod
 
         chain_mod.clear_cache()
@@ -190,14 +190,14 @@ class TestSpillPolicy:
             if ex.stats.groups >= 1:
                 break
             _t.sleep(0.01)
-        assert ex._device_item_ms is None  # cold drain excluded
+        assert ex._device_ms_per_mb is None  # cold drain excluded
         # a second, warm drain seeds it
         ex.process(_img(100, 80, seed=1), _resize_plan(100, 80, 40))
         for _ in range(100):
-            if ex._device_item_ms is not None:
+            if ex._device_ms_per_mb is not None:
                 break
             _t.sleep(0.01)
-        assert ex._device_item_ms is not None
+        assert ex._device_ms_per_mb is not None
         ex.shutdown()
 
 

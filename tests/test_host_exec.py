@@ -61,7 +61,7 @@ def test_spill_triggers_when_device_saturated(img):
     ex = Executor(ExecutorConfig(host_spill=True, spill_factor=1.0))
     try:
         # simulate a measured slow link: 1s per item drain
-        ex._device_item_ms = 1000.0
+        ex._device_ms_per_mb = 10000.0
         o = ImageOptions(width=64, height=48)
         plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
         reset_placement()
@@ -74,12 +74,51 @@ def test_spill_triggers_when_device_saturated(img):
         ex.shutdown()
 
 
+def test_cost_model_is_size_aware(img):
+    """Placement estimates are per-unit (wire MB / source Mpix): a 4K-class
+    item carries a ~600x larger wait/cost footprint than a thumbnail-class
+    one, and a 4K item sitting in the device queue delays a small follower
+    by ITS byte count — one global per-item EWMA could express neither
+    (r4: the 4K pipeline route was mis-costed by exactly this)."""
+    ex = Executor(ExecutorConfig(host_spill=True, probe_interval=10**9))
+    try:
+        from imaginary_tpu.engine.executor import _Item
+
+        o = ImageOptions(width=64, height=48)
+        small = _Item(img, plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3))
+        big_arr = np.zeros((2160, 3840, 3), np.uint8)
+        big = _Item(big_arr, plan_operation("resize", ImageOptions(width=1280),
+                                            2160, 3840, 0, 3))
+        assert big.wire_mb > 50 * small.wire_mb  # 270x480 vs 4K source
+        assert big.mpix > 50 * small.mpix
+        # measured-tunnel-class rates: both sizes prefer the host...
+        ex._device_ms_per_mb = 33.0
+        ex._host_ms_per_mpix = 8.0
+        assert ex._should_spill(big)
+        assert ex._should_spill(small)
+        # ...PCIe-class rates: neither spills...
+        ex._device_ms_per_mb = 0.05
+        assert not ex._should_spill(big)
+        assert not ex._should_spill(small)
+        # ...and one queued 4K item's BYTES (not its item count) are what
+        # push a small follower over the spill threshold
+        assert not ex._should_spill(small)
+        ex._owed_mb = big.wire_mb
+        ex._device_ms_per_mb = 1.0
+        assert ex._should_spill(small)
+        ex._owed_mb = small.wire_mb  # same queue LENGTH, tiny bytes
+        assert not ex._should_spill(small)
+    finally:
+        ex._owed_mb = 0.0
+        ex.shutdown()
+
+
 def test_no_spill_when_device_fast(img):
     from imaginary_tpu.engine.executor import last_placement, reset_placement
 
     ex = Executor(ExecutorConfig(host_spill=True))
     try:
-        ex._device_item_ms = 0.01  # fast PCIe-class link
+        ex._device_ms_per_mb = 0.01  # fast PCIe-class link
         o = ImageOptions(width=64, height=48)
         plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
         reset_placement()
