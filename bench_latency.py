@@ -243,9 +243,10 @@ async def main_async():
 
     from aiohttp import web as aioweb
 
-    from imaginary_tpu.web.app import create_app
+    from imaginary_tpu.web.app import create_app, tune_gc_for_serving
     from imaginary_tpu.web.config import ServerOptions
 
+    tune_gc_for_serving()  # measure the tuned serving process, like serve()
     o = ServerOptions(port=port)
     # access log to /dev/null: stdout must stay pure JSONL, and an
     # in-memory sink would grow unboundedly inside the measured process
@@ -294,16 +295,18 @@ async def main_async():
                 if bad:
                     print(f"[lat] WARM FAILURE {name} burst={burst}: {bad} — "
                           f"route fails under concurrent load", file=sys.stderr)
-            # calibrate: mean serial latency sets this route's offered rate
+            # calibrate: MEDIAN serial latency sets this route's offered
+            # rate (a mean lets one straggler — a late compile, a cost-model
+            # warmup ride — cut the offered rate several-fold)
             ts = []
-            for i in range(3):
+            for i in range(5):
                 t0 = time.monotonic()
                 st = await once(paths[i % len(paths)], body, method)
                 if st != 200:
                     print(f"[lat] WARM FAILURE {name} calibration -> {st}",
                           file=sys.stderr)
                 ts.append((time.monotonic() - t0) * 1000.0)
-            serial_ms[name] = sum(ts) / len(ts)
+            serial_ms[name] = sorted(ts)[len(ts) // 2]
             print(f"[lat] warm {name}: serial={serial_ms[name]:.1f}ms", file=sys.stderr)
 
     workloads = _cv2_workloads(buf, buf4k)

@@ -88,6 +88,15 @@ class ExecutorConfig:
     host_spill: Optional[bool] = None
     spill_factor: float = 6.0
     probe_interval: int = 64
+    # Probes are SHADOW copies: the probing request itself serves from the
+    # host (a device ride would put the full drain latency into the
+    # request's tail — measured as exactly the p99 on the latency bench),
+    # while a duplicate item rides the device solely to refresh the rate
+    # estimate, its result discarded. A shadow is skipped when its
+    # estimated device time exceeds this budget (probing a 4K chain over a
+    # dying link would burn seconds to learn what the estimate already
+    # says); stale per-key rates self-heal through the 8x-global cap.
+    probe_budget_ms: float = 250.0
     # Record the device_wait/d2h split per drain (costs one extra link
     # round-trip per group to sync compute before the readback). Off by
     # default: the serving path drains with a single device_get and books
@@ -120,6 +129,7 @@ class ExecutorStats:
     device_failures: int = 0  # failed device dispatch/drain events
     breaker_opens: int = 0  # times the circuit breaker tripped
     breaker_host_served: int = 0  # requests served by host during an outage
+    shadow_probes: int = 0  # discarded device rides that refresh the cost model
     device_ms_per_mb: float = 0.0  # measured drain cost per wire megabyte
     host_ms_per_mpix: float = 0.0  # measured host CPU cost per megapixel
 
@@ -139,6 +149,7 @@ class ExecutorStats:
             "device_failures": self.device_failures,
             "breaker_opens": self.breaker_opens,
             "breaker_host_served": self.breaker_host_served,
+            "shadow_probes": self.shadow_probes,
             "device_ms_per_mb": round(self.device_ms_per_mb, 3),
             "host_ms_per_mpix": round(self.host_ms_per_mpix, 3),
         }
@@ -251,14 +262,28 @@ class Executor:
         self._fetch_queue: queue_mod.Queue = queue_mod.Queue(maxsize=self.config.max_inflight)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
-        self._owed_mb = 0.0  # wire MB enqueued for the device, not yet done
+        # Estimated milliseconds of device work enqueued and not yet done.
+        # Charged at enqueue time at the ITEM'S OWN rate (its key's, else
+        # global) and released by the same amount on completion — summing
+        # megabytes and multiplying by one rate would price a queue of
+        # cheap-key bytes at an expensive arrival's rate.
+        self._owed_ms = 0.0
         self._owed_lock = threading.Lock()
         self._consec_device_failures = 0
         self._breaker_open_until = 0.0  # monotonic; 0 = closed
         self._device_ms_per_mb: Optional[float] = None  # EWMA, fetcher-updated
+        # Per-chain-key refinement of the global rate: on a real TPU drains
+        # are bytes-bound and every chain prices the same, but chains whose
+        # compute dominates (big blur radii, or the CPU-jax fallback
+        # backend where everything is compute) drain at very different
+        # ms/MB — a global average would under-price the expensive chain
+        # and keep feeding it to a device that can't keep up. Bounded dict;
+        # groups are single-key so each drain books cleanly.
+        self._rate_by_key: dict = {}
         self._drain_floor_ms: Optional[float] = None  # smallest warm drain (fixed cost)
         self._host_ms_per_mpix: float = 15.0  # EWMA, bootstrap (~2 ms / 0.13 Mpix)
         self._spill_seen = 0
+        self._probe_slots_skipped = 0
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
         self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher", daemon=True)
@@ -325,16 +350,32 @@ class Executor:
                 _PLACEMENT.value = "host"
                 item.future.set_result(out)
                 return item.future
-        with self._owed_lock:
-            self._owed_mb += item.wire_mb
-        wire_mb = item.wire_mb
-        item.future.add_done_callback(lambda _f: self._on_done(wire_mb))
+        self._charge_owed(item)
         self._queue.put(item)
         return item.future
 
-    def _on_done(self, wire_mb: float) -> None:
+    def _charge_owed(self, item: "_Item") -> None:
+        """Book the item's estimated device milliseconds against the queue;
+        the done-callback releases exactly what was charged."""
+        est_ms = item.wire_mb * self._rate_for(item.key)
         with self._owed_lock:
-            self._owed_mb -= wire_mb
+            self._owed_ms += est_ms
+        item.future.add_done_callback(lambda _f: self._on_done(est_ms))
+
+    def _rate_for(self, key) -> float:
+        """Effective ms/MB for a key: its own measured rate where known,
+        capped at 8x the global so yesterday's-link prices re-earn device
+        placement as the global improves; 0 while the device is unpriced."""
+        glob = self._device_ms_per_mb
+        if glob is None:
+            return 0.0
+        with self._owed_lock:
+            key_rate = self._rate_by_key.get(key)
+        return glob if key_rate is None else min(key_rate, 8.0 * glob)
+
+    def _on_done(self, est_ms: float) -> None:
+        with self._owed_lock:
+            self._owed_ms -= est_ms
 
     def _breaker_is_open(self) -> bool:
         with self._owed_lock:
@@ -362,13 +403,13 @@ class Executor:
             self._breaker_open_until = 0.0
 
     def _should_spill(self, item: "_Item") -> bool:
-        dev_rate = self._device_ms_per_mb
-        if dev_rate is None:  # device cost unknown: it is the primary path
-            return False
+        if self._device_ms_per_mb is None:
+            return False  # device cost unknown: it is the primary path
+        dev_rate = self._rate_for(item.key)
         with self._owed_lock:
-            owed_mb = self._owed_mb
+            owed_ms = self._owed_ms
             host_rate = self._host_ms_per_mpix
-        wait_ms = (owed_mb + item.wire_mb) * dev_rate
+        wait_ms = owed_ms + item.wire_mb * dev_rate
         host_ms = max(item.mpix, 1e-3) * host_rate
         if wait_ms <= self.config.spill_factor * host_ms:
             return False
@@ -376,8 +417,43 @@ class Executor:
             return False
         with self._owed_lock:
             self._spill_seen += 1
-            probe = self._spill_seen % self.config.probe_interval == 0
-        return not probe  # periodic probe keeps device_ms_per_mb fresh
+            seen = self._spill_seen
+        if seen % self.config.probe_interval == 0:
+            # Probe slot. A normal probe ships only when it is cheap AND
+            # safe: within the budget, unsharded (mesh launches pad
+            # differently than the batch-1 warmth check models), and
+            # hitting the compile cache — probes measure the LINK, and
+            # paying a fresh XLA compile (minutes on a CPU-fallback
+            # backend) would starve the very host path the spill protects.
+            # But rate estimates only move when SOMETHING drains, so after
+            # 16 consecutively skipped slots a shadow ships UNGATED: its
+            # possible compile is excluded from the EWMA by the cold-drain
+            # rule, and the drain after it measures the recovered link.
+            cheap = (
+                item.wire_mb * dev_rate <= self.config.probe_budget_ms
+                and self._sharding is None
+                and chain_mod.single_is_warm(item.arr, item.plan)
+            )
+            with self._owed_lock:
+                if not cheap:
+                    self._probe_slots_skipped += 1
+                ship = cheap or self._probe_slots_skipped >= 16
+                if ship:
+                    self._probe_slots_skipped = 0
+            if ship:
+                self._enqueue_shadow(item)
+        return True
+
+    def _enqueue_shadow(self, item: "_Item") -> None:
+        """Duplicate an item onto the device queue purely to refresh the
+        cost model; the result is discarded (the real request serves from
+        the host). The input array is shared read-only — launch_batch
+        copies it into the batch stack."""
+        shadow = _Item(item.arr, item.plan)
+        self.stats.shadow_probes += 1
+        self._charge_owed(shadow)
+        shadow.future.add_done_callback(lambda f: f.exception())  # swallow
+        self._queue.put(shadow)
 
     def process(self, arr: np.ndarray, plan: ImagePlan, timeout: float = 120.0) -> np.ndarray:
         """Blocking convenience wrapper."""
@@ -560,8 +636,10 @@ class Executor:
             # drains absurdly high (permanent spill); scaling the byte
             # denominator by an item-count ratio (the pre-r4 'boost') would
             # under-book a singleton LARGE item by the same ratio. The
-            # residual is clamped below by 5% of the drain so the estimate
-            # stays optimistic-but-nonzero when fixed cost dominates.
+            # residual is clamped below by 25% of the drain so the estimate
+            # stays optimistic-but-nonzero when fixed cost dominates (and a
+            # compute-bound fallback "device", whose floor-sized drains ARE
+            # the marginal cost, still registers as expensive under load).
             t_done = time.monotonic()
             drain_ms = (t_done - t0) * 1000.0
             if not cold:
@@ -569,24 +647,43 @@ class Executor:
                 if t_ready is not None:
                     TIMES.record("device_wait", (t_ready - t0) * 1000.0 / max(1, n_items))
                     TIMES.record("d2h", (t_done - t_ready) * 1000.0 / max(1, n_items))
-            group_mb = sum(it.wire_mb for c in chunks for it in c[3])
+            # the link moved the PADDED batches (power-of-two launch padding
+            # duplicates items in both directions), so charge the padded
+            # count, not just the real items — c[1] is the padded arr list
+            group_mb = sum(c[3][0].wire_mb * len(c[1]) for c in chunks)
             prev = self._device_ms_per_mb
             if cold:
                 pass  # compile-inclusive drain: not a link-cost sample
             else:
                 if self._drain_floor_ms is None or drain_ms < self._drain_floor_ms:
                     self._drain_floor_ms = drain_ms
-                per_mb = max(drain_ms - self._drain_floor_ms, 0.05 * drain_ms) / max(
+                per_mb = max(drain_ms - self._drain_floor_ms, 0.25 * drain_ms) / max(
                     group_mb, 1e-3
                 )
-                if prev is not None and per_mb > 4.0 * prev:
-                    # clamp outlier samples (GC pause, tunnel hiccup) so one
-                    # bad drain can't flip the placement policy wholesale
-                    per_mb = 4.0 * prev
-                self._device_ms_per_mb = (
-                    per_mb if prev is None else 0.7 * prev + 0.3 * per_mb
-                )
+                # clamp outlier samples (GC pause, tunnel hiccup) so one bad
+                # drain can't flip the placement policy wholesale. The
+                # per-key estimate clamps against ITS OWN history — clamping
+                # it by the global average would strangle learning for a
+                # chain that is legitimately 100x the average (a 4K chain on
+                # a compute-bound backend) while its requests snowball.
+                g = per_mb if prev is None else min(per_mb, 4.0 * prev)
+                self._device_ms_per_mb = g if prev is None else 0.7 * prev + 0.3 * g
                 self.stats.device_ms_per_mb = self._device_ms_per_mb
+                key = chunks[0][3][0].key  # groups are single-key
+                with self._owed_lock:
+                    kprev = self._rate_by_key.get(key)
+                    if kprev is None and len(self._rate_by_key) >= 256:
+                        self._rate_by_key.clear()  # bounded; re-learns fast
+                    if kprev is None:
+                        # seed clamped against the global so one GC-paused
+                        # first drain can't pin a fresh key sky-high (the
+                        # 8x-global cap in _rate_for bounds the damage, but
+                        # a sane seed converges instead of saturating)
+                        k = per_mb if prev is None else min(per_mb, 16.0 * prev)
+                        self._rate_by_key[key] = k
+                    else:
+                        k = min(per_mb, 4.0 * kprev)
+                        self._rate_by_key[key] = 0.7 * kprev + 0.3 * k
             for host_y, (y, arrs, plans, sub) in zip(fetched, chunks):
                 try:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)

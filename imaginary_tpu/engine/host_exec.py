@@ -112,31 +112,95 @@ def _run_yuv(arr: np.ndarray, plan):
     link can't. Chains with non-resample stages take the general route:
     planes -> RGB -> stage loop -> planes.
     """
-    from imaginary_tpu.codecs import YuvPlanes, unpack_planes, yuv_planes_to_rgb
+    from imaginary_tpu.codecs import YuvPlanes, unpack_planes
 
     ph, wb = plan.in_bucket
     hb = (ph * 2) // 3
     h, w = plan.in_h, plan.in_w
     planes = unpack_planes(arr, h, w, hb, wb)
-    y, u, v = planes.y, planes.u, planes.v
     inner = plan.stages[1:-1]
 
-    if all(isinstance(st.spec, SampleSpec) for st in inner):
-        y3, u3, v3 = y[:, :, None], u[:, :, None], v[:, :, None]
-        for st in inner:
-            dh, dw = int(st.dyn["dst_h"]), int(st.dyn["dst_w"])
-            y3 = _apply(st.spec, y3, st.dyn)
-            cdyn = {"dst_h": np.float32((dh + 1) // 2), "dst_w": np.float32((dw + 1) // 2)}
-            u3 = _apply(st.spec, u3, cdyn)
-            v3 = _apply(st.spec, v3, cdyn)
-        return YuvPlanes(y=_round_u8(y3)[:, :, 0], u=_round_u8(u3)[:, :, 0],
-                         v=_round_u8(v3)[:, :, 0])
+    _PLANE_SPECS = (SampleSpec, ExtractSpec, ShrinkBucketSpec, FlipSpec,
+                    FlopSpec, TransposeSpec, BlurSpec)
+    if all(isinstance(st.spec, _PLANE_SPECS) for st in inner):
+        return _planewise(planes, inner)
 
-    x = yuv_planes_to_rgb(planes)
+    x = _i420_to_rgb(planes)
     for st in inner:
         x = _apply(st.spec, x, st.dyn)
-    x = np.clip(x.astype(np.float32), 0.0, 255.0)
+    return _rgb_to_i420(x)
+
+
+def _planewise(planes, inner):
+    """Geometry/blur chains run on the subsampled planes directly — no
+    color-space round trip at all. Chroma windows/mirrors land on halved
+    coordinates (a <=1 luma-pixel chroma-siting shift on odd offsets and
+    odd-dim mirrors), and chroma blurs at sigma/2 — all within this path's
+    documented PSNR-equivalence to the device output."""
+    from imaginary_tpu.codecs import YuvPlanes
+
+    y3 = planes.y[:, :, None]
+    u3 = planes.u[:, :, None]
+    v3 = planes.v[:, :, None]
+    for st in inner:
+        spec = st.spec
+        if isinstance(spec, ShrinkBucketSpec):
+            continue  # host buffers are never bucket-padded
+        if isinstance(spec, SampleSpec):
+            dh, dw = int(st.dyn["dst_h"]), int(st.dyn["dst_w"])
+            y3 = _apply(spec, y3, st.dyn)
+            cdyn = {"dst_h": np.float32((dh + 1) // 2), "dst_w": np.float32((dw + 1) // 2)}
+            u3 = _apply(spec, u3, cdyn)
+            v3 = _apply(spec, v3, cdyn)
+        elif isinstance(spec, ExtractSpec):
+            top, left = int(st.dyn["top"]), int(st.dyn["left"])
+            nh, nw = int(st.dyn["new_h"]), int(st.dyn["new_w"])
+            y3 = y3[top : top + nh, left : left + nw]
+            ct, cl = top // 2, left // 2
+            ch, cw = (nh + 1) // 2, (nw + 1) // 2
+            u3 = u3[ct : ct + ch, cl : cl + cw]
+            v3 = v3[ct : ct + ch, cl : cl + cw]
+        elif isinstance(spec, BlurSpec):
+            half = {"sigma": np.float32(float(st.dyn["sigma"]) / 2.0)}
+            y3 = _apply(spec, y3, st.dyn)
+            u3 = _apply(spec, u3, half)
+            v3 = _apply(spec, v3, half)
+        else:  # Flip / Flop / Transpose apply identically per plane
+            y3 = _apply(spec, y3, st.dyn)
+            u3 = _apply(spec, u3, st.dyn)
+            v3 = _apply(spec, v3, st.dyn)
+    return YuvPlanes(y=_round_u8(y3)[:, :, 0], u=_round_u8(u3)[:, :, 0],
+                     v=_round_u8(v3)[:, :, 0])
+
+
+def _i420_to_rgb(planes) -> np.ndarray:
+    """Planes -> RGB for the general spill path. cv2's SIMD full-range
+    YCrCb converter (the JPEG convention — its *_I420 variants are
+    video-range and would shift every pixel) runs ~10x the numpy fallback
+    on megapixel images."""
+    from imaginary_tpu.codecs import yuv_planes_to_rgb
+
+    h, w = planes.y.shape
+    if _HAS_CV2:
+        uu = cv2.resize(planes.u, (w, h), interpolation=cv2.INTER_LINEAR)
+        vv = cv2.resize(planes.v, (w, h), interpolation=cv2.INTER_LINEAR)
+        return cv2.cvtColor(cv2.merge([planes.y, vv, uu]), cv2.COLOR_YCrCb2RGB)
+    return yuv_planes_to_rgb(planes)
+
+
+def _rgb_to_i420(x: np.ndarray):
+    """RGB (float or uint8) -> 4:2:0 planes for the general spill path."""
+    from imaginary_tpu.codecs import YuvPlanes
+
     out_h, out_w = x.shape[:2]
+    if _HAS_CV2:
+        ycc = cv2.cvtColor(_round_u8(x), cv2.COLOR_RGB2YCrCb)
+        yy, cr, cb = cv2.split(ycc)
+        ch, cw = (out_h + 1) // 2, (out_w + 1) // 2
+        u = cv2.resize(cb, (cw, ch), interpolation=cv2.INTER_AREA)
+        v = cv2.resize(cr, (cw, ch), interpolation=cv2.INTER_AREA)
+        return YuvPlanes(y=yy, u=u, v=v)
+    x = np.clip(np.asarray(x, np.float32), 0.0, 255.0)
     yy = 0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2]
     cb = -0.168736 * x[..., 0] - 0.331264 * x[..., 1] + 0.5 * x[..., 2] + 128.0
     cr = 0.5 * x[..., 0] - 0.418688 * x[..., 1] - 0.081312 * x[..., 2] + 128.0

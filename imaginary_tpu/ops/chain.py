@@ -70,6 +70,27 @@ def cache_size() -> int:
     return len(_CACHE)
 
 
+def single_is_warm(arr: np.ndarray, plan: ImagePlan, sharding=None) -> bool:
+    """True when a batch-of-one launch of this (chain, bucket) pair would
+    hit the compile cache. Used to gate cost-model shadow probes: a probe
+    measures the LINK, and paying a fresh XLA compile (minutes on a CPU
+    fallback backend) to learn a transfer rate would starve the host it is
+    supposed to be protecting."""
+    specs = plan.spec_key()
+    if not specs:
+        return True
+    if plan.in_bucket is not None:
+        shape = (1,) + arr.shape
+    else:
+        hb, wb = bucket_shape(arr.shape[0], arr.shape[1])
+        shape = (1, hb, wb, arr.shape[2])
+    dyns = _stack_dyns([plan])
+    dyn_key = tuple(
+        tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
+    )
+    return (specs, shape, dyn_key, _sharding_cache_key(sharding)) in _CACHE
+
+
 def clear_cache() -> None:
     with _LOCK:
         _CACHE.clear()

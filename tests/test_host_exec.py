@@ -100,16 +100,16 @@ def test_cost_model_is_size_aware(img):
         ex._device_ms_per_mb = 0.05
         assert not ex._should_spill(big)
         assert not ex._should_spill(small)
-        # ...and one queued 4K item's BYTES (not its item count) are what
-        # push a small follower over the spill threshold
+        # ...and one queued 4K item's estimated MILLISECONDS (not its item
+        # count) are what push a small follower over the spill threshold
         assert not ex._should_spill(small)
-        ex._owed_mb = big.wire_mb
         ex._device_ms_per_mb = 1.0
+        ex._owed_ms = big.wire_mb * 1.0  # a queued 4K item's worth
         assert ex._should_spill(small)
-        ex._owed_mb = small.wire_mb  # same queue LENGTH, tiny bytes
+        ex._owed_ms = small.wire_mb * 1.0  # same queue LENGTH, tiny bytes
         assert not ex._should_spill(small)
     finally:
-        ex._owed_mb = 0.0
+        ex._owed_ms = 0.0
         ex.shutdown()
 
 
