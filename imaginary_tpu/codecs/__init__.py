@@ -398,12 +398,35 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
         raise CodecError(f"cannot encode array of shape {arr.shape}", 500)
     if arr.dtype != np.uint8:
         raise CodecError(f"cannot encode dtype {arr.dtype}", 500)
+    if opts.type is ImageType.HEIF:
+        # ABOVE-REFERENCE capability: the reference maps 'heif' to
+        # bimg.UNKNOWN and rejects the request — it never encodes HEIF
+        # (/root/reference/type.go:25-44). We encode real HEIF when
+        # libheif carries an HEVC encoder plugin; without one this raises
+        # and the pipeline's documented failure fallback yields JPEG.
+        from imaginary_tpu.codecs import vector_backend as vb
+
+        if vb.heif_encode_available("hevc"):
+            try:
+                return vb.encode_heif(arr, opts.effective_quality(), "hevc")
+            except Exception as e:
+                raise CodecError(f"Cannot encode image: {e}", 400) from None
+        raise CodecError("HEIF encoding requires a libheif HEVC encoder", 400)
     if opts.type is ImageType.AVIF:
-        # only PIL's avif plugin encodes AVIF; the native/cv2 backends
-        # would raise and trigger the JPEG fallback unnecessarily
+        # PIL's avif plugin when compiled in, else libheif's AV1 encoder
         from imaginary_tpu.codecs import pil_backend
 
-        return pil_backend.encode(arr, opts)
+        try:
+            return pil_backend.encode(arr, opts)
+        except ImageError:
+            from imaginary_tpu.codecs import vector_backend as vb
+
+            if vb.heif_encode_available("av1"):
+                try:
+                    return vb.encode_heif(arr, opts.effective_quality(), "av1")
+                except Exception as e:
+                    raise CodecError(f"Cannot encode image: {e}", 400) from None
+            raise
     return _backend().encode(arr, opts)
 
 

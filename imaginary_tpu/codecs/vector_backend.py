@@ -377,6 +377,145 @@ def heif_size(buf: bytes) -> tuple:
         h.heif_context_free(ctx)
 
 
+_HEIF_COMPRESSION = {"hevc": 1, "av1": 4}
+_HEIF_CHROMA_INTERLEAVED_RGB = 10
+_heif_enc_ready = False
+
+
+def _setup_heif_encode():
+    global _heif_enc_ready
+    if _heif_enc_ready:
+        return
+    h = _heif
+    h.heif_context_get_encoder_for_format.restype = _HeifError
+    h.heif_context_get_encoder_for_format.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)
+    ]
+    h.heif_encoder_set_lossy_quality.restype = _HeifError
+    h.heif_encoder_set_lossy_quality.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    h.heif_image_create.restype = _HeifError
+    h.heif_image_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    h.heif_image_add_plane.restype = _HeifError
+    h.heif_image_add_plane.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int
+    ]
+    h.heif_image_get_plane.restype = ctypes.POINTER(ctypes.c_ubyte)
+    h.heif_image_get_plane.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
+    ]
+    h.heif_context_encode_image.restype = _HeifError
+    h.heif_context_encode_image.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    h.heif_context_write_to_file.restype = _HeifError
+    h.heif_context_write_to_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    h.heif_encoder_release.argtypes = [ctypes.c_void_p]
+    _heif_enc_ready = True
+
+
+_heif_enc_probe: dict = {}
+
+
+def heif_encode_available(fmt: str = "hevc") -> bool:
+    """True when libheif carries an encoder plugin for the format — the
+    reference CANNOT encode HEIF at all (its ImageType maps 'heif' to
+    bimg.UNKNOWN and requests are rejected), so this whole path is an
+    above-reference capability, gated like every optional loader.
+    Probed once per format: constructing an x265 encoder instance just to
+    check availability is too expensive for the per-request path."""
+    if fmt in _heif_enc_probe:
+        return _heif_enc_probe[fmt]
+    ok = False
+    if heif_available():
+        _setup_heif()
+        _setup_heif_encode()
+        h = _heif
+        ctx = h.heif_context_alloc()
+        try:
+            enc = ctypes.c_void_p(None)
+            e = h.heif_context_get_encoder_for_format(
+                ctx, _HEIF_COMPRESSION[fmt], ctypes.byref(enc)
+            )
+            if e.code == 0 and enc:
+                h.heif_encoder_release(enc)
+                ok = True
+        finally:
+            h.heif_context_free(ctx)
+    _heif_enc_probe[fmt] = ok
+    return ok
+
+
+def encode_heif(arr: np.ndarray, quality: int = 80, fmt: str = "hevc") -> bytes:
+    """HWC uint8 (C in 1/3/4) -> HEIF (hevc) or AVIF (av1) bytes.
+
+    Writes through a temp file: libheif's streaming writer callback
+    returns a struct by value, which ctypes callbacks cannot express
+    portably; the file detour costs one buffer copy."""
+    if not heif_available():
+        raise RuntimeError("libheif not available on this host")
+    _setup_heif()
+    _setup_heif_encode()
+    h = _heif
+    if arr.ndim != 3 or arr.dtype != np.uint8:
+        raise ValueError("encode_heif wants HWC uint8")
+    if arr.shape[2] == 1:
+        arr = np.repeat(arr, 3, axis=2)
+    has_alpha = arr.shape[2] == 4
+    chroma = _HEIF_CHROMA_INTERLEAVED_RGBA if has_alpha else _HEIF_CHROMA_INTERLEAVED_RGB
+    ht, w, c = arr.shape
+    ctx = h.heif_context_alloc()
+    enc = ctypes.c_void_p(None)
+    img = ctypes.c_void_p(None)
+    try:
+        e = h.heif_context_get_encoder_for_format(
+            ctx, _HEIF_COMPRESSION[fmt], ctypes.byref(enc)
+        )
+        if e.code != 0:
+            raise ValueError(f"libheif: no {fmt} encoder")
+        h.heif_encoder_set_lossy_quality(enc, max(1, min(int(quality), 100)))
+        e = h.heif_image_create(w, ht, _HEIF_COLORSPACE_RGB, chroma, ctypes.byref(img))
+        if e.code != 0:
+            raise ValueError("libheif: image_create failed")
+        e = h.heif_image_add_plane(img, _HEIF_CHANNEL_INTERLEAVED, w, ht, 8)
+        if e.code != 0:
+            raise ValueError("libheif: add_plane failed")
+        stride = ctypes.c_int(0)
+        plane = h.heif_image_get_plane(img, _HEIF_CHANNEL_INTERLEAVED, ctypes.byref(stride))
+        if not plane:
+            raise ValueError("libheif: no plane")
+        dst = np.ctypeslib.as_array(plane, shape=(ht, stride.value))
+        src = np.ascontiguousarray(arr).reshape(ht, w * c)
+        dst[:, : w * c] = src
+        e = h.heif_context_encode_image(ctx, img, enc, None, None)
+        if e.code != 0:
+            raise ValueError(
+                f"libheif encode: {e.message.decode() if e.message else e.code}"
+            )
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".heif")
+        os.close(fd)
+        try:
+            e = h.heif_context_write_to_file(ctx, path.encode())
+            if e.code != 0:
+                raise ValueError("libheif: write failed")
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+    finally:
+        if img:
+            h.heif_image_release(img)
+        if enc:
+            h.heif_encoder_release(enc)
+        h.heif_context_free(ctx)
+
+
 # ---------------------------------------------------------------------------
 # PDF via poppler-glib (present in the deploy image; gated elsewhere)
 # ---------------------------------------------------------------------------

@@ -242,3 +242,4 @@ class TestYuvSpill:
         out = host_exec.run(packed, wrapped)
         assert isinstance(out, codecs.YuvPlanes)
         assert out.y.shape == (113, 200)
+
