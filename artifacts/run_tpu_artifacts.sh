@@ -1,0 +1,19 @@
+#!/bin/bash
+# Wait for the axon TPU tunnel to come back, then capture the round-4
+# TPU-backed artifacts: the 6-route latency run and a fresh headline bench.
+# Each probe is a fresh short-lived process (a hung tunnel blocks forever
+# inside jax.devices(), so liveness must be checked with a timeout).
+cd /root/repo
+probe() {
+  timeout 75 python -c "import jax; jax.devices(); import jax.numpy as j; (j.ones((8,8))@j.ones((8,8))).block_until_ready()" 2>/dev/null
+}
+echo "[watchdog] waiting for TPU tunnel..." >&2
+until probe; do
+  sleep 120
+done
+echo "[watchdog] tunnel is back; running latency artifact" >&2
+BENCH_SECS=15 timeout 1800 python bench_latency.py \
+  > artifacts/bench_latency_r04_tpu.jsonl 2> artifacts/bench_latency_r04_tpu.log
+echo "[watchdog] latency done; running headline bench" >&2
+timeout 900 python bench.py > artifacts/bench_r04_tpu.json 2> artifacts/bench_r04_tpu.log
+echo "[watchdog] all TPU artifacts captured" >&2

@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
     p.add_argument("--spatial", type=int, default=1,
                    help="spatial mesh axis size (W-shard huge images across chips)")
+    p.add_argument("--spatial-threshold-px", type=int, default=3840 * 2160,
+                   help="bucket pixel count at which W-sharding engages")
     p.add_argument("--host-spill", default="auto", choices=["auto", "on", "off"],
                    help="spill to host SIMD when the device link saturates "
                         "(auto = enabled, governed by the measured cost "
@@ -146,6 +148,7 @@ def options_from_args(args) -> ServerOptions:
         use_mesh=args.use_mesh,
         n_devices=args.devices or None,
         spatial=max(1, args.spatial),
+        spatial_threshold_px=max(1, args.spatial_threshold_px),
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         prewarm=args.prewarm,
         distributed=args.distributed,

@@ -53,6 +53,10 @@ class ServerOptions:
     use_mesh: bool = False
     n_devices: Optional[int] = None
     spatial: int = 1  # spatial mesh axis (W-sharding for >=4K inputs)
+    # pixel count at which a bucket's W axis shards across the spatial
+    # mesh axis (default: 4K-class); mirrors ExecutorConfig — test_engine
+    # pins the three definitions (here, CLI, executor) equal
+    spatial_threshold_px: int = 3840 * 2160
     # host SIMD spill under link saturation: None = auto (spill only when the
     # host has spare cores), True/False force it. Spilled pixels come from the
     # host interpreter (same dims, PSNR-equivalent but not bit-identical);

@@ -76,6 +76,7 @@ class ImageService:
                 use_mesh=o.use_mesh,
                 n_devices=o.n_devices,
                 spatial=o.spatial,
+                spatial_threshold_px=o.spatial_threshold_px,
                 host_spill=o.host_spill,
             )
         )

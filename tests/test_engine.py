@@ -243,6 +243,14 @@ class TestBatchLadderUnification:
         assert ServerOptions().max_batch == MAX_BATCH
         args = build_parser().parse_args([])
         assert args.max_batch == MAX_BATCH
+        # spatial threshold: kept literal in the import-light config/CLI
+        # modules (jax must not load for --help); this pin is the single
+        # source of truth across the three definitions
+        assert (
+            ExecutorConfig().spatial_threshold_px
+            == ServerOptions().spatial_threshold_px
+            == args.spatial_threshold_px
+        )
 
     def test_batch_ladder_covers_padding(self):
         from imaginary_tpu.engine.executor import batch_ladder
