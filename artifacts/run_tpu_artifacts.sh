@@ -12,8 +12,16 @@ until probe; do
   sleep 120
 done
 echo "[watchdog] tunnel is back; running latency artifact" >&2
-BENCH_SECS=15 timeout 1800 python bench_latency.py \
-  > artifacts/bench_latency_r04_tpu.jsonl 2> artifacts/bench_latency_r04_tpu.log
+if ! BENCH_SECS=15 timeout 1800 python bench_latency.py \
+  > artifacts/bench_latency_r04_tpu.jsonl 2> artifacts/bench_latency_r04_tpu.log; then
+  echo "[watchdog] LATENCY RUN FAILED/TIMED OUT — artifact incomplete" >&2
+  mv artifacts/bench_latency_r04_tpu.jsonl artifacts/bench_latency_r04_tpu.jsonl.partial 2>/dev/null
+  exit 1
+fi
 echo "[watchdog] latency done; running headline bench" >&2
-timeout 900 python bench.py > artifacts/bench_r04_tpu.json 2> artifacts/bench_r04_tpu.log
+if ! timeout 900 python bench.py > artifacts/bench_r04_tpu.json 2> artifacts/bench_r04_tpu.log; then
+  echo "[watchdog] BENCH RUN FAILED/TIMED OUT — artifact incomplete" >&2
+  mv artifacts/bench_r04_tpu.json artifacts/bench_r04_tpu.json.partial 2>/dev/null
+  exit 1
+fi
 echo "[watchdog] all TPU artifacts captured" >&2

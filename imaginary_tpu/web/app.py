@@ -95,6 +95,25 @@ def make_ssl_context(o: ServerOptions) -> Optional[ssl.SSLContext]:
         return None
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2  # ref: server.go:115
+    # Pin the reference's cipher suites and curve preferences
+    # (server.go:114-131): ECDHE + AES-GCM / ChaCha20-Poly1305 only.
+    # OpenSSL names for Go's TLS_ECDHE_{ECDSA,RSA}_WITH_* list; TLS 1.3
+    # suites are governed separately by OpenSSL and remain default-on.
+    ctx.set_ciphers(
+        "ECDHE-ECDSA-AES256-GCM-SHA384:ECDHE-RSA-AES256-GCM-SHA384:"
+        "ECDHE-ECDSA-AES128-GCM-SHA256:ECDHE-RSA-AES128-GCM-SHA256:"
+        "ECDHE-ECDSA-CHACHA20-POLY1305:ECDHE-RSA-CHACHA20-POLY1305"
+    )
+    # The reference also pins curve preferences (X25519, P-256, P-384).
+    # Python's ssl module cannot express a key-share group preference list
+    # before 3.13 (set_ecdh_curve takes a single EC curve and would DROP
+    # X25519); OpenSSL's default group order already leads with X25519, so
+    # the default is left in place rather than pinned wrong.
+    # ALPN: http/1.1 only. The reference advertises h2 because Go's
+    # net/http serves it natively; aiohttp has no HTTP/2 server and no h2
+    # library ships in this environment, so advertising h2 would break
+    # negotiation rather than add parity. Documented gap in PARITY.md.
+    ctx.set_alpn_protocols(["http/1.1"])
     ctx.load_cert_chain(o.cert_file, o.key_file)
     return ctx
 

@@ -543,3 +543,43 @@ class TestSpatialServedRequest:
             assert stats["spatial_batches"] >= 1
 
         run(o, fn)
+
+
+class TestTLSConfig:
+    """TLS context mirrors the reference's pinned config (server.go:114-131):
+    TLS >= 1.2 and the ECDHE + AES-GCM/ChaCha20 cipher list. Curve
+    preferences stay at OpenSSL defaults (X25519-first anyway) — the ssl
+    module can't express a group list before 3.13. ALPN advertises
+    http/1.1 only — aiohttp has no h2 server and no h2 library exists in
+    this environment (documented gaps in PARITY.md)."""
+
+    def test_ssl_context_pins_reference_ciphers(self, tmp_path):
+        import ssl
+        import subprocess
+
+        crt, key = tmp_path / "t.crt", tmp_path / "t.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(crt), "-days", "1",
+             "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        from imaginary_tpu.web.app import make_ssl_context
+
+        o = ServerOptions(cert_file=str(crt), key_file=str(key))
+        ctx = make_ssl_context(o)
+        assert ctx is not None
+        assert ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+        names = {c["name"] for c in ctx.get_ciphers()}
+        # every pinned TLS1.2 suite is ECDHE with AEAD; no CBC/RSA-kex leaks
+        tls12 = {n for n in names if not n.startswith("TLS_")}
+        assert tls12 == {
+            "ECDHE-ECDSA-AES256-GCM-SHA384", "ECDHE-RSA-AES256-GCM-SHA384",
+            "ECDHE-ECDSA-AES128-GCM-SHA256", "ECDHE-RSA-AES128-GCM-SHA256",
+            "ECDHE-ECDSA-CHACHA20-POLY1305", "ECDHE-RSA-CHACHA20-POLY1305",
+        }
+
+    def test_no_tls_without_both_files(self):
+        from imaginary_tpu.web.app import make_ssl_context
+
+        assert make_ssl_context(ServerOptions(cert_file="/tmp/x.crt")) is None
