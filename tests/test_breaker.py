@@ -1,0 +1,119 @@
+"""Device circuit breaker (SURVEY.md section 5.3 analogue): after
+breaker_threshold CONSECUTIVE failed device dispatches, host-executable
+requests fail over to the host interpreter instead of 400-ing one by one;
+a device success closes the breaker."""
+
+import numpy as np
+import pytest
+
+from imaginary_tpu.engine import Executor, ExecutorConfig
+from imaginary_tpu.engine.executor import last_placement, reset_placement
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops.plan import plan_operation
+
+
+def _img(h=96, w=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+
+
+def _plan(h=96, w=128, width=48):
+    return plan_operation("resize", ImageOptions(width=width), h, w, 0, 3)
+
+
+@pytest.fixture
+def broken_device(monkeypatch):
+    """Every device launch raises, as a dead link would."""
+    from imaginary_tpu.engine import executor as ex_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("link down")
+
+    monkeypatch.setattr(ex_mod.chain_mod, "launch_batch", boom)
+
+
+def test_breaker_opens_after_consecutive_failures(broken_device):
+    ex = Executor(ExecutorConfig(window_ms=1, host_spill=False,
+                                 breaker_threshold=3, breaker_cooldown_s=60))
+    try:
+        # first three device failures surface to their callers...
+        for i in range(3):
+            with pytest.raises(Exception):
+                ex.process(_img(seed=i), _plan(), timeout=30)
+        assert ex.stats.device_failures >= 3
+        assert ex.stats.breaker_opens == 1
+        # ...then the open breaker serves host-executable plans from the
+        # host interpreter, no device attempt, correct pixels
+        reset_placement()
+        out = ex.process(_img(seed=9), _plan())
+        assert out.shape == (36, 48, 3)
+        assert ex.stats.breaker_host_served == 1
+        assert last_placement() == "host"
+    finally:
+        ex.shutdown()
+
+
+def test_breaker_serves_yuv_plans_during_outage(broken_device):
+    """Packed-transport plans fail over too: the host interpreter returns
+    YuvPlanes the raw encoder can consume."""
+    from io import BytesIO
+
+    from PIL import Image
+
+    from imaginary_tpu import codecs
+    from imaginary_tpu.ops.buckets import bucket_shape
+    from imaginary_tpu.ops.plan import wrap_plan_yuv420
+
+    if not codecs.yuv420_supported():
+        pytest.skip("native YUV420 codec not built")
+    out = BytesIO()
+    Image.fromarray(_img(120, 160)).save(out, "JPEG", quality=85, subsampling=2)
+    hb, wb = bucket_shape(120, 160)
+    packed, h, w, _ = codecs.decode_yuv420(out.getvalue(), 1, hb, wb)
+    wrapped = wrap_plan_yuv420(_plan(120, 160, 80), 120, 160)
+
+    ex = Executor(ExecutorConfig(window_ms=1, host_spill=False,
+                                 breaker_threshold=2, breaker_cooldown_s=60))
+    try:
+        for i in range(2):
+            with pytest.raises(Exception):
+                ex.process(_img(seed=i), _plan(), timeout=30)
+        got = ex.process(packed, wrapped)
+        assert isinstance(got, codecs.YuvPlanes)
+        assert got.y.shape == (60, 80)
+        body = codecs.encode_yuv(got, codecs.EncodeOptions())
+        assert Image.open(BytesIO(body)).size == (80, 60)
+    finally:
+        ex.shutdown()
+
+
+def test_breaker_closes_on_device_success(monkeypatch):
+    from imaginary_tpu.engine import executor as ex_mod
+
+    real = ex_mod.chain_mod.launch_batch
+    fail = {"on": True}
+
+    def flaky(*a, **k):
+        if fail["on"]:
+            raise RuntimeError("link down")
+        return real(*a, **k)
+
+    monkeypatch.setattr(ex_mod.chain_mod, "launch_batch", flaky)
+    ex = Executor(ExecutorConfig(window_ms=1, host_spill=False,
+                                 breaker_threshold=2, breaker_cooldown_s=0.05))
+    try:
+        for i in range(2):
+            with pytest.raises(Exception):
+                ex.process(_img(seed=i), _plan(), timeout=30)
+        assert ex.stats.breaker_opens == 1
+        fail["on"] = False
+        import time
+
+        time.sleep(0.1)  # cooldown expires; next request probes the device
+        reset_placement()
+        out = ex.process(_img(seed=5), _plan())
+        assert out.shape == (36, 48, 3)
+        assert last_placement() == "device"
+        assert not ex._breaker_is_open()
+    finally:
+        ex.shutdown()
