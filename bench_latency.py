@@ -98,7 +98,7 @@ from bench_util import make_1080p_jpeg as _make_1080p_jpeg  # noqa: E402
 from bench_util import pctl as _pctl  # noqa: E402
 
 
-async def _fire(session, url, method, body, lats, errors):
+async def _fire(session, url, method, body, lats, errors, marks, t_start):
     t0 = time.monotonic()
     try:
         async with session.request(method, url, data=body) as resp:
@@ -109,7 +109,9 @@ async def _fire(session, url, method, body, lats, errors):
     except Exception:
         errors.append(-1)
         return
-    lats.append((time.monotonic() - t0) * 1000.0)
+    t1 = time.monotonic()
+    lats.append((t1 - t0) * 1000.0)
+    marks.append((t0 - t_start, (t1 - t0) * 1000.0))
 
 
 async def run_route(base, name, pathq, method, body, rate, secs):
@@ -120,6 +122,7 @@ async def run_route(base, name, pathq, method, body, rate, secs):
     paths = pathq if isinstance(pathq, list) else [pathq]
     lats: list = []
     errors: list = []
+    marks: list = []  # (send-offset s, latency ms) for straggler forensics
     interval = 1.0 / rate
     n = int(rate * secs)
     conn = aiohttp.TCPConnector(limit=0)
@@ -135,10 +138,18 @@ async def run_route(base, name, pathq, method, body, rate, secs):
             tasks.append(
                 asyncio.create_task(
                     _fire(session, base + paths[i % len(paths)], method, body,
-                          lats, errors)
+                          lats, errors, marks, t_start)
                 )
             )
         await asyncio.gather(*tasks)
+    # The p99 verdict on a 300-request window is set by its ~3 slowest
+    # requests; print WHEN they were sent so a tail can be told apart
+    # (cluster at one instant = one stall event — GC, probe, compile;
+    # spread uniformly = steady-state service variance).
+    worst = sorted(marks, key=lambda m: -m[1])[:5]
+    print(f"[lat]   {name} stragglers: "
+          + ", ".join(f"{lat:.1f}ms@{off:.2f}s" for off, lat in worst),
+          file=sys.stderr)
     sent = n
     ok = len(lats)
     res = {
@@ -260,11 +271,18 @@ async def main_async():
     buf = _make_1080p_jpeg()
     base_url = f"http://127.0.0.1:{port}"
 
-    buf4k = _make_4k_png() if os.environ.get("BENCH_4K", "1") == "1" else None
+    only = os.environ.get("BENCH_ONLY", "")
+    keep = {s.strip() for s in only.split(",") if s.strip()} if only else None
+    want_4k = os.environ.get("BENCH_4K", "1") == "1" and (
+        keep is None or "pipeline_4k_png" in keep
+    )
+    buf4k = _make_4k_png() if want_4k else None
     scenarios = [(n, p, m, buf, "1080p_jpeg") for n, p, m in ROUTES]
     scenarios.append(("mixed_thumb_crop_rotate", MIXED_ROUTES, "POST", buf, "1080p_jpeg"))
     if buf4k:
         scenarios.append(("pipeline_4k_png", PIPELINE_4K, "POST", buf4k, "4k_png"))
+    if keep is not None:
+        scenarios = [s for s in scenarios if s[0] in keep]
 
     # Warm every route's compile cache — including the batch-size ladder:
     # the executor pads micro-batches to powers of two, and each size is
@@ -310,6 +328,8 @@ async def main_async():
             print(f"[lat] warm {name}: serial={serial_ms[name]:.1f}ms", file=sys.stderr)
 
     workloads = _cv2_workloads(buf, buf4k)
+    if keep is not None:  # BENCH_ONLY: don't burn ~41 cv2 iterations per
+        workloads = {n: w for n, w in workloads.items() if n in keep}  # unmeasured route
     baselines = {}
     for name, (fn, per_call) in workloads.items():
         baselines[name] = baseline_latency(fn, per_call)
@@ -324,7 +344,13 @@ async def main_async():
         # offered rate is recorded in the JSON so a FAIL at 20 rps and a
         # PASS at 3 rps are never conflated.
         route_rate = min(rate, max(0.5, 700.0 / max(serial_ms.get(name, 1.0), 1.0)))
+        stats0 = app["service"].executor.stats.to_dict()
         res = await run_route(base_url, name, pathq, method, body, route_rate, secs)
+        stats1 = app["service"].executor.stats.to_dict()
+        delta = {k: round(stats1[k] - stats0[k], 3)
+                 for k in ("items", "spilled", "shadow_probes", "groups")
+                 if isinstance(stats1.get(k), (int, float))}
+        print(f"[lat]   {name} executor delta: {delta}", file=sys.stderr)
         res["input"] = inp
         res["rate_requested_rps"] = rate
         base = baselines.get(name)

@@ -88,6 +88,13 @@ class ExecutorConfig:
     host_spill: Optional[bool] = None
     spill_factor: float = 6.0
     probe_interval: int = 64
+    # Wall-clock backstop on the count gate: at 20 rps, every-64th fires a
+    # 3.5 MB H2D staging copy every ~3 s, and on a 1-CPU host each one
+    # steals ~20 ms from whatever request it coincides with — measured as
+    # EXACTLY the latency bench's remaining p99 stragglers (5 probes, 5
+    # stragglers, evenly spaced at the probe period). One probe per
+    # probe_min_interval_s prices a stable link just as well.
+    probe_min_interval_s: float = 10.0
     # Probes are SHADOW copies: the probing request itself serves from the
     # host (a device ride would put the full drain latency into the
     # request's tail — measured as exactly the p99 on the latency bench),
@@ -153,6 +160,27 @@ class ExecutorStats:
             "device_ms_per_mb": round(self.device_ms_per_mb, 3),
             "host_ms_per_mpix": round(self.host_ms_per_mpix, 3),
         }
+
+
+# Measured link seed, installed by prewarm (prewarm.py): (ms_per_mb,
+# floor_ms). Until the first warm drain books a sample, a fresh executor
+# has NO price for the device link and routes everything to it — on a
+# slow tunneled link that means a cold server's first requests each eat a
+# multi-hundred-ms drain the host path would have served in ~10 ms. The
+# prewarm pass already runs warm device calls; timing them prices the
+# link before the first real request arrives. The EWMA refines the seed
+# from real drains immediately, so a stale seed costs at most a few
+# conservative placements.
+_LINK_SEED: Optional[tuple] = None
+
+
+def seed_link_rate(ms_per_mb: float, floor_ms: float) -> None:
+    global _LINK_SEED
+    _LINK_SEED = (max(float(ms_per_mb), 0.0), max(float(floor_ms), 0.0))
+
+
+def link_seed() -> Optional[tuple]:
+    return _LINK_SEED
 
 
 # Per-thread record of where the last submit()'s pixels were computed
@@ -272,6 +300,11 @@ class Executor:
         self._consec_device_failures = 0
         self._breaker_open_until = 0.0  # monotonic; 0 = closed
         self._device_ms_per_mb: Optional[float] = None  # EWMA, fetcher-updated
+        # prewarm-measured starting estimate; a 0.0 rate is "unpriced", not
+        # "free" — the EWMA's multiplicative clamps could never leave 0
+        if _LINK_SEED is not None and _LINK_SEED[0] > 0.0:
+            self._device_ms_per_mb = _LINK_SEED[0]
+            self.stats.device_ms_per_mb = _LINK_SEED[0]
         # Per-chain-key refinement of the global rate: on a real TPU drains
         # are bytes-bound and every chain prices the same, but chains whose
         # compute dominates (big blur radii, or the CPU-jax fallback
@@ -281,9 +314,14 @@ class Executor:
         # groups are single-key so each drain books cleanly.
         self._rate_by_key: dict = {}
         self._drain_floor_ms: Optional[float] = None  # smallest warm drain (fixed cost)
+        if _LINK_SEED is not None and _LINK_SEED[1] > 0.0:
+            self._drain_floor_ms = _LINK_SEED[1]
         self._host_ms_per_mpix: float = 15.0  # EWMA, bootstrap (~2 ms / 0.13 Mpix)
         self._spill_seen = 0
         self._probe_slots_skipped = 0
+        # "never": the first probe slot is free — a fresh executor's rates
+        # deserve a sample as soon as the count gate allows one
+        self._last_shadow_t = float("-inf")
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
         self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher", daemon=True)
@@ -409,7 +447,16 @@ class Executor:
         with self._owed_lock:
             owed_ms = self._owed_ms
             host_rate = self._host_ms_per_mpix
-        wait_ms = owed_ms + item.wire_mb * dev_rate
+        # The floor term is load-bearing for the LATENCY tail: every drain
+        # pays the link's fixed round-trip (~65 ms on the tunneled bench
+        # link) on top of bytes x rate, and an item deciding placement
+        # cannot count on sharing it — group amortization only happens
+        # when OTHER items also chose the device. Omitting it caused a
+        # measured flap cycle: big amortized drains dip the per-MB EWMA,
+        # a few requests ride at an estimate half their realized cost,
+        # their 300-477 ms drains set the route's p99, the rate rises,
+        # spill resumes, repeat (~6 s period on the r4 latency bench).
+        wait_ms = owed_ms + (self._drain_floor_ms or 0.0) + item.wire_mb * dev_rate
         host_ms = max(item.mpix, 1e-3) * host_rate
         if wait_ms <= self.config.spill_factor * host_ms:
             return False
@@ -434,12 +481,27 @@ class Executor:
                 and self._sharding is None
                 and chain_mod.single_is_warm(item.arr, item.plan)
             )
+            now = time.monotonic()
             with self._owed_lock:
+                # Two gates, two different meanings. The wall clock
+                # throttles CHEAP probes (a stale-but-cheap slot means a
+                # probe WILL ship at the next fresh slot, so it must NOT
+                # feed the escape — under load, slots come every few
+                # hundred ms and counting them would fire the ungated
+                # escape on a cadence that bypasses both the min-interval
+                # and the budget/warmth safety checks). The 16-slot escape
+                # counts only NOT-CHEAP slots: an overpriced rate makes
+                # every slot fail the budget check — which is evaluated
+                # with that same wrong rate — so the escape is the only
+                # recovery path, and it fires at the pre-gate cadence
+                # (~16 slots), not 16 x probe_min_interval_s.
+                fresh = now - self._last_shadow_t >= self.config.probe_min_interval_s
                 if not cheap:
                     self._probe_slots_skipped += 1
-                ship = cheap or self._probe_slots_skipped >= 16
+                ship = (cheap and fresh) or self._probe_slots_skipped >= 16
                 if ship:
                     self._probe_slots_skipped = 0
+                    self._last_shadow_t = now
             if ship:
                 self._enqueue_shadow(item)
         return True

@@ -82,6 +82,7 @@ def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
 
     built = 0
     seen = set()
+    warmed: list = []  # (plan, kind, dh, dw, b) that compiled+ran clean
     t0 = time.time()
     for op, opts, (h, w) in _COMMON:
         try:
@@ -123,8 +124,74 @@ def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
                             arr = np.zeros((dh, dw, 3), dtype=np.uint8)
                         chain_mod.run_batch([arr] * b, [pl] * b)
                         built += 1
+                        warmed.append((pl, kind, dh, dw, b))
                     except Exception:
                         continue
+    seeded = _seed_link_rate(warmed)
     if verbose:
-        print(f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s")
+        msg = f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s"
+        if seeded:
+            msg += f"; link seeded at {seeded[0]:.2f} ms/MB (floor {seeded[1]:.1f} ms)"
+        print(msg)
     return built
+
+
+def _dummy_input(pl, kind, dh, dw) -> np.ndarray:
+    if kind == "yuv":
+        ph, wb = pl.in_bucket
+        return np.zeros((ph, wb, 1), dtype=np.uint8)
+    return np.zeros((dh, dw, 3), dtype=np.uint8)
+
+
+def _wire_mb(pl, kind, dh, dw) -> float:
+    """Wire megabytes one item of this plan moves across the link —
+    priced by the executor's OWN item accounting (_Item.wire_mb), so the
+    seed and the EWMA that refines it can never diverge in unit."""
+    from imaginary_tpu.engine.executor import _Item
+
+    return _Item(_dummy_input(pl, kind, dh, dw), pl).wire_mb
+
+
+def _seed_link_rate(warmed: list):
+    """Time two already-compiled drains of very different wire sizes and
+    install the solved (ms/MB, fixed floor) into the executor module, so
+    the first executor created prices the device link from measurement
+    instead of assuming it is free (engine/executor.py seed_link_rate).
+    Returns the installed (rate, floor) or None."""
+    if not warmed:
+        return None
+    from imaginary_tpu.engine import executor as executor_mod
+
+    cands = [(_wire_mb(pl, kind, dh, dw) * b, pl, kind, dh, dw, b)
+             for pl, kind, dh, dw, b in warmed]
+    small = min(cands, key=lambda c: c[0])
+    big = max(cands, key=lambda c: c[0])
+    if big[0] - small[0] < 0.25:  # need spread to fit a slope
+        return None
+
+    def timed(c) -> float:
+        mb, pl, kind, dh, dw, b = c
+        arr = _dummy_input(pl, kind, dh, dw)
+        best = float("inf")
+        for _ in range(2):  # min-of-2 dodges a one-off GC/tunnel hiccup
+            t = time.monotonic()
+            chain_mod.run_batch([arr] * b, [pl] * b)
+            best = min(best, (time.monotonic() - t) * 1000.0)
+        return best
+
+    try:
+        t_small = timed(small)
+        t_big = timed(big)
+    except Exception:
+        return None  # device died mid-prewarm: serve unseeded
+    rate = (t_big - t_small) / (big[0] - small[0])
+    if rate <= 0.0:
+        # Jitter inverted the slope (a stall on the small candidate's both
+        # runs). A 0.0 seed would be a permanent wedge: the EWMA's
+        # multiplicative clamps (min(per_mb, 4x prev)) can never escape
+        # prev == 0, so the link would be priced free forever. Serve
+        # unseeded — the first real drain prices it.
+        return None
+    floor = max(t_small - small[0] * rate, 0.0)
+    executor_mod.seed_link_rate(rate, floor)
+    return rate, floor

@@ -31,6 +31,18 @@ import pytest  # noqa: E402
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "testdata")
 
 
+@pytest.fixture(autouse=True)
+def _isolate_link_seed(monkeypatch):
+    """prewarm_common_chains installs a process-global link-rate seed that
+    every later Executor consumes; a machine-timing-dependent seed leaking
+    across test files would flip placement decisions (device vs host)
+    non-deterministically. Every test starts unseeded; monkeypatch
+    restores whatever was there before."""
+    from imaginary_tpu.engine import executor as executor_mod
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+
+
 @pytest.fixture(scope="session")
 def testdata():
     """Path to the generated fixture directory (see tests/gen_fixtures.py)."""

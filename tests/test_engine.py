@@ -206,7 +206,9 @@ class TestStageTimes:
         from imaginary_tpu.engine.timing import TIMES
 
         TIMES.reset()
-        ex = Executor(ExecutorConfig(window_ms=1))
+        # host_spill off: the test pins DEVICE-path stage metrics, and with
+        # the drain-floor term a priced link correctly spills tiny items
+        ex = Executor(ExecutorConfig(window_ms=1, host_spill=False))
         ex.process(_img(100, 80), _resize_plan(100, 80, 40))
         ex.process(_img(100, 80, seed=1), _resize_plan(100, 80, 40))
         snap = TIMES.snapshot()
@@ -220,7 +222,8 @@ class TestStageTimes:
         from imaginary_tpu.engine.timing import TIMES
 
         TIMES.reset()
-        ex = Executor(ExecutorConfig(window_ms=1, split_drain_timing=True))
+        ex = Executor(ExecutorConfig(window_ms=1, split_drain_timing=True,
+                                     host_spill=False))
         ex.process(_img(100, 80), _resize_plan(100, 80, 40))
         ex.process(_img(100, 80, seed=1), _resize_plan(100, 80, 40))
         snap = TIMES.snapshot()

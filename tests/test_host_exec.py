@@ -113,6 +113,41 @@ def test_cost_model_is_size_aware(img):
         ex.shutdown()
 
 
+def test_shadow_probes_rate_limited_by_wall_clock(img):
+    """The probe count gate is backed by probe_min_interval_s: on a 1-CPU
+    host each shadow's H2D staging steals ~20 ms from whatever request it
+    coincides with (measured as the latency bench's remaining p99
+    stragglers), so within one interval at most ONE shadow ships no
+    matter how many count slots pass — and stale-but-CHEAP slots must not
+    feed the 16-slot ungated escape (that would re-open the very cadence
+    the gate closes, minus its budget/warmth safety checks)."""
+    from imaginary_tpu.ops import chain as chain_mod
+
+    o = ImageOptions(width=64, height=48)
+    plan = plan_operation("resize", o, img.shape[0], img.shape[1], 1, 3)
+    chain_mod.run_single(img, plan)  # warm: the cheap gate checks the cache
+    # spill_factor ~0 forces every request to spill while the small rate
+    # keeps the probe well under probe_budget_ms — the cheap path is
+    # genuinely open and ONLY the wall clock blocks it
+    ex = Executor(ExecutorConfig(host_spill=True, spill_factor=0.001,
+                                 probe_interval=2, probe_min_interval_s=3600.0))
+    try:
+        ex._device_ms_per_mb = 10.0
+        ex._drain_floor_ms = 5.0
+        for _ in range(40):
+            ex.process(img, plan)
+        assert ex.stats.spilled == 40
+        # 20 count slots: the first ships (never probed before), the other
+        # 19 are cheap+stale -> blocked, and they must NOT accumulate into
+        # the escape (19 > 16 would ship a second, ungated, shadow)
+        assert ex.stats.shadow_probes == 1
+        # skipped==0 proves the ship rode the CHEAP path (budget+warmth
+        # open) — an escape-path ship would leave a nonzero residue
+        assert ex._probe_slots_skipped == 0
+    finally:
+        ex.shutdown()
+
+
 def test_no_spill_when_device_fast(img):
     from imaginary_tpu.engine.executor import last_placement, reset_placement
 

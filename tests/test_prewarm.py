@@ -58,6 +58,97 @@ def test_prewarm_bad_env_degrades(monkeypatch):
     assert prewarm.prewarm_common_chains(verbose=False) >= 1  # fell back to ladder
 
 
+def test_seed_link_rate_consumed_by_new_executor(monkeypatch):
+    """A prewarm-installed link seed prices the device for executors
+    created afterwards: a host-executable item whose estimated device
+    wait exceeds spill_factor x host cost spills on the FIRST request —
+    no unpriced ride over a slow link (the r4 cold-start wart: a fresh
+    server's first requests each ate a full drain the host path serves
+    in ~10 ms)."""
+    from imaginary_tpu.engine import executor as executor_mod
+    from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+    from imaginary_tpu.ops.plan import plan_operation
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+    executor_mod.seed_link_rate(500.0, 40.0)  # a dreadful link: 500 ms/MB
+    ex = Executor(ExecutorConfig(host_spill=True))
+    try:
+        assert ex._device_ms_per_mb == 500.0
+        assert ex._drain_floor_ms == 40.0
+        arr = np.zeros((256, 384, 3), dtype=np.uint8)
+        plan = plan_operation("resize", ImageOptions(width=64), 256, 384, 0, 3)
+        out = ex.process(arr, plan, timeout=60)
+        assert out.shape[0] > 0
+        assert ex.stats.spilled == 1  # priced link -> host, no device ride
+        assert ex.stats.items == 0
+    finally:
+        ex.shutdown()
+
+
+def test_seed_link_rate_solved_from_warm_drains(monkeypatch):
+    """_seed_link_rate times a small and a large warm drain and installs a
+    nonnegative (ms/MB, floor) pair."""
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.engine import executor as executor_mod
+    from imaginary_tpu.ops.plan import plan_operation
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+    small = plan_operation("resize", ImageOptions(width=24), 64, 96, 0, 3)
+    big = plan_operation("resize", ImageOptions(width=300), 512, 768, 0, 3)
+    got = prewarm._seed_link_rate(
+        [(small, None, 64, 96, 1), (big, None, 512, 768, 2)]
+    )
+    assert got is not None
+    rate, floor = got
+    assert rate >= 0.0 and floor >= 0.0
+    assert executor_mod.link_seed() == (rate, floor)
+
+
+def test_seed_link_rate_rejects_inverted_slope(monkeypatch):
+    """Jitter can time the big drain FASTER than the small one; a 0.0
+    seed would wedge the EWMA at 'link is free' forever (multiplicative
+    clamps never leave 0), so no seed must install."""
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.engine import executor as executor_mod
+    from imaginary_tpu.ops.plan import plan_operation
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+    monkeypatch.setattr(prewarm.chain_mod, "run_batch", lambda arrs, pls: None)
+    small = plan_operation("resize", ImageOptions(width=24), 64, 96, 0, 3)
+    big = plan_operation("resize", ImageOptions(width=300), 512, 768, 0, 3)
+    assert prewarm._seed_link_rate(
+        [(small, None, 64, 96, 1), (big, None, 512, 768, 2)]
+    ) is None  # both drains ~0 ms -> slope <= 0 -> unseeded
+    assert executor_mod.link_seed() is None
+
+
+def test_zero_rate_seed_treated_as_unpriced(monkeypatch):
+    """Even if seed_link_rate is handed a 0.0 rate directly, a new
+    executor must treat the link as unpriced, not free."""
+    from imaginary_tpu.engine import executor as executor_mod
+    from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+    executor_mod.seed_link_rate(0.0, 5.0)
+    ex = Executor(ExecutorConfig(host_spill=True))
+    try:
+        assert ex._device_ms_per_mb is None
+    finally:
+        ex.shutdown()
+
+
+def test_seed_link_rate_skips_degenerate_spread(monkeypatch):
+    """Two near-identical wire sizes cannot fit a slope: no seed installed."""
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.engine import executor as executor_mod
+    from imaginary_tpu.ops.plan import plan_operation
+
+    monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
+    pl = plan_operation("resize", ImageOptions(width=24), 64, 96, 0, 3)
+    assert prewarm._seed_link_rate([(pl, None, 64, 96, 1)]) is None
+    assert executor_mod.link_seed() is None
+
+
 def test_persistent_cache_degrades_on_unwritable(monkeypatch):
     """chmod can't stop root, so simulate the read-only fs directly."""
     from imaginary_tpu import prewarm
