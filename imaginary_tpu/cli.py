@@ -47,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-allowed-resolution", type=float, default=18.0, help="max megapixels")
     p.add_argument("--certfile", default="")
     p.add_argument("--keyfile", default="")
+    p.add_argument("--disable-http2", action="store_true",
+                   help="serve http/1.1 only over TLS (h2 is on by default, like the reference)")
     p.add_argument("--authorization", default="", help="fixed Authorization header for origins")
     p.add_argument("--forward-headers", default="", help="CSV of headers to forward")
     p.add_argument("--placeholder", default="", help="placeholder image path")
@@ -132,6 +134,7 @@ def options_from_args(args) -> ServerOptions:
         max_allowed_pixels=args.max_allowed_resolution,
         cert_file=args.certfile,
         key_file=args.keyfile,
+        http2=not args.disable_http2,
         authorization=args.authorization,
         forward_headers=parse_forward_headers(args.forward_headers),
         placeholder=args.placeholder,

@@ -14,6 +14,19 @@ from aiohttp import web
 
 _LEVELS = {"debug": 0, "info": 0, "warning": 400, "error": 500}
 
+# Set by serve() when the in-process HTTP/2 terminator is active: a
+# random per-process token the terminator attaches as X-Internal-Hop.
+# X-Forwarded-* is trusted ONLY on requests carrying the exact token —
+# being a loopback peer is NOT enough (any local h1 client, or any local
+# process hitting the internal loopback listener directly, arrives from
+# 127.0.0.1 and could otherwise forge log identity).
+_TRUSTED_HOP_TOKEN: str = ""
+
+
+def set_trusted_hop_token(token: str) -> None:
+    global _TRUSTED_HOP_TOKEN
+    _TRUSTED_HOP_TOKEN = token
+
 
 def access_log_middleware(level: str = "info", out=None):
     threshold = _LEVELS.get(level.lower(), 0)
@@ -35,9 +48,19 @@ def access_log_middleware(level: str = "info", out=None):
                 elapsed = time.monotonic() - start
                 ts = time.strftime("%d/%b/%Y %H:%M:%S", time.localtime())
                 peer = request.remote or "-"
+                httpv = f"{request.version.major}.{request.version.minor}"
+                if (
+                    _TRUSTED_HOP_TOKEN
+                    and request.headers.get("X-Internal-Hop") == _TRUSTED_HOP_TOKEN
+                ):
+                    # the in-process HTTP/2 terminator proved itself with
+                    # the per-process token: its X-Forwarded-* carry the
+                    # real client identity and protocol (web/http2.py)
+                    peer = request.headers.get("X-Forwarded-For", peer)
+                    httpv = request.headers.get("X-Forwarded-HTTP-Version", httpv)
                 line = (
                     f'{peer} - - [{ts}] "{request.method} {request.path_qs} '
-                    f'HTTP/{request.version.major}.{request.version.minor}" '
+                    f'HTTP/{httpv}" '
                     f"{status} {length} {elapsed:.4f}\n"
                 )
                 stream.write(line)

@@ -109,13 +109,25 @@ def make_ssl_context(o: ServerOptions) -> Optional[ssl.SSLContext]:
     # before 3.13 (set_ecdh_curve takes a single EC curve and would DROP
     # X25519); OpenSSL's default group order already leads with X25519, so
     # the default is left in place rather than pinned wrong.
-    # ALPN: http/1.1 only. The reference advertises h2 because Go's
-    # net/http serves it natively; aiohttp has no HTTP/2 server and no h2
-    # library ships in this environment, so advertising h2 would break
-    # negotiation rather than add parity. Documented gap in PARITY.md.
-    ctx.set_alpn_protocols(["http/1.1"])
+    # ALPN: h2 + http/1.1, like the reference (Go's net/http advertises h2
+    # natively — server.go:114). Our h2 terminator rides libnghttp2 via
+    # ctypes (web/http2.py); when that library is absent, or --disable-http2
+    # is set, only http/1.1 is offered so negotiation can never select a
+    # protocol we cannot speak.
+    if _h2_active(o):
+        ctx.set_alpn_protocols(["h2", "http/1.1"])
+    else:
+        ctx.set_alpn_protocols(["http/1.1"])
     ctx.load_cert_chain(o.cert_file, o.key_file)
     return ctx
+
+
+def _h2_active(o: ServerOptions) -> bool:
+    if not getattr(o, "http2", True):
+        return False
+    from imaginary_tpu.web.http2 import load_nghttp2
+
+    return load_nghttp2() is not None
 
 
 async def serve(o: ServerOptions, mrelease: int = 30) -> None:
@@ -126,8 +138,47 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
     app = create_app(o)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, o.address or None, o.port, ssl_context=make_ssl_context(o))
-    await site.start()
+    ssl_ctx = make_ssl_context(o)
+    h2_server = None
+    h2_client = None
+    if ssl_ctx is not None and _h2_active(o):
+        # HTTP/2 termination (web/http2.py): an internal loopback h1
+        # listener serves BOTH protocols' requests — h2 streams are
+        # decoded by nghttp2 and forwarded one hop so middleware,
+        # handlers, and access log never fork behavior by protocol.
+        import secrets
+
+        import aiohttp
+
+        from imaginary_tpu.web import accesslog
+        from imaginary_tpu.web.http2 import AlpnDispatcher, H2Protocol
+
+        loopback = web.TCPSite(runner, "127.0.0.1", 0)
+        await loopback.start()
+        lb_port = loopback._server.sockets[0].getsockname()[1]
+        h2_client = aiohttp.ClientSession(
+            auto_decompress=False,  # bytes pass through verbatim
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+        # per-process token: the access log trusts X-Forwarded-* only from
+        # requests that prove they came through OUR terminator hop
+        hop_token = secrets.token_hex(16)
+        accesslog.set_trusted_hop_token(hop_token)
+        h2_conns: set = set()
+        loop_ = asyncio.get_running_loop()
+        h2_server = await loop_.create_server(
+            lambda: AlpnDispatcher(
+                runner.server,
+                lambda: H2Protocol(lb_port, h2_client, hop_token=hop_token,
+                                   conns=h2_conns),
+            ),
+            o.address or None,
+            o.port,
+            ssl=ssl_ctx,
+        )
+    else:
+        site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx)
+        await site.start()
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -142,9 +193,25 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
 
     ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
     scheme = "https" if o.cert_file and o.key_file else "http"
-    print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}")
+    proto = " (h2+http/1.1)" if h2_server is not None else ""
+    print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}{proto}")
     await stop.wait()
     print("shutting down server")
     if ticker:
         ticker.cancel()
+    if h2_server is not None:
+        # stop accepting, then give in-flight h2 streams the same 5 s
+        # drain h1 gets from runner.cleanup — closing h2_client while a
+        # stream's loopback hop is mid-flight would 502 a request the h1
+        # path would have completed
+        h2_server.close()
+        await h2_server.wait_closed()
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while (
+            any(p.has_inflight() for p in h2_conns)
+            and asyncio.get_running_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.05)
+    if h2_client is not None:
+        await h2_client.close()
     await asyncio.wait_for(runner.cleanup(), timeout=5)

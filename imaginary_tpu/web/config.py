@@ -35,6 +35,10 @@ class ServerOptions:
     mount: str = ""
     cert_file: str = ""
     key_file: str = ""
+    # HTTP/2 over TLS (ALPN h2), matching Go net/http's default; served by
+    # the nghttp2-backed terminator in web/http2.py. Auto-degrades to
+    # http/1.1-only when libnghttp2 is absent.
+    http2: bool = True
     authorization: str = ""
     placeholder: str = ""
     placeholder_status: int = 0

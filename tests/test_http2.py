@@ -1,0 +1,241 @@
+"""HTTP/2 serving (web/http2.py): ALPN negotiation, stream decode,
+loopback bridging, and the http/1.1 fallback — graded end-to-end with
+curl's OWN nghttp2-backed client as the independent protocol oracle
+(the reference gets h2 from Go's net/http; server.go:114-131)."""
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("curl") is None
+    or b"HTTP2" not in subprocess.run(["curl", "-V"], capture_output=True).stdout
+    and b"nghttp2" not in subprocess.run(["curl", "-V"], capture_output=True).stdout,
+    reason="curl with HTTP/2 support unavailable",
+)
+
+
+def _lib_present() -> bool:
+    from imaginary_tpu.web.http2 import load_nghttp2
+
+    return load_nghttp2() is not None
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def h2_server(tmp_path_factory, testdata):
+    if not _lib_present():
+        pytest.skip("libnghttp2 not present")
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable for test certs")
+    tmp = tmp_path_factory.mktemp("h2")
+    cert, key = str(tmp / "cert.pem"), str(tmp / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "imaginary_tpu", "--port", str(port),
+         "--certfile", cert, "--keyfile", key],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    base = f"https://127.0.0.1:{port}"
+    deadline = time.time() + 90
+    up = False
+    while time.time() < deadline:
+        r = subprocess.run(["curl", "-sk", "-o", "/dev/null", "-w", "%{http_code}",
+                            base + "/health"], capture_output=True, timeout=10)
+        if r.stdout == b"200":
+            up = True
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(1.0)
+    if not up:
+        out = proc.stdout.read().decode(errors="replace") if proc.poll() is not None else ""
+        proc.kill()
+        pytest.fail(f"h2 test server failed to start: {out[-2000:]}")
+    yield base, os.path.join(testdata, "large.jpg")
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _curl(args, timeout=60):
+    return subprocess.run(["curl", "-sk"] + args, capture_output=True, timeout=timeout)
+
+
+def test_h2_negotiated_and_resize_correct(h2_server, tmp_path):
+    base, img = h2_server
+    out = str(tmp_path / "out.jpg")
+    r = _curl(["--http2", "-o", out, "-w", "%{http_version} %{http_code} %{content_type}",
+               "-F", f"file=@{img}", base + "/resize?width=300&height=200"])
+    ver, code, ctype = r.stdout.decode().split()
+    assert (ver, code, ctype) == ("2", "200", "image/jpeg")
+    from PIL import Image
+
+    assert Image.open(out).size == (300, 200)  # PIL is the dims oracle
+
+
+def test_http11_fallback_same_port(h2_server, tmp_path):
+    base, img = h2_server
+    out = str(tmp_path / "out.jpg")
+    r = _curl(["--http1.1", "-o", out, "-w", "%{http_version} %{http_code}",
+               "-F", f"file=@{img}", base + "/resize?width=300&height=200"])
+    ver, code = r.stdout.decode().split()
+    assert (ver, code) == ("1.1", "200")
+    from PIL import Image
+
+    assert Image.open(out).size == (300, 200)
+
+
+def test_h2_error_semantics_preserved(h2_server):
+    base, img = h2_server
+    # missing params -> the service's own 400, not a protocol error
+    r = _curl(["--http2", "-o", "/dev/null", "-w", "%{http_version} %{http_code}",
+               "-X", "POST", base + "/resize?width=100"])
+    assert r.stdout.decode().split() == ["2", "400"]
+    r = _curl(["--http2", "-o", "/dev/null", "-w", "%{http_version} %{http_code}",
+               base + "/nonexistent"])
+    assert r.stdout.decode().split() == ["2", "404"]
+
+
+def test_h2_multiplexed_streams(h2_server, tmp_path):
+    """curl --parallel multiplexes streams over one connection; every
+    stream must come back whole. Bodies ride --data-binary, not -F:
+    curl 7.88's parallel mode sends EMPTY bodies for all but one
+    transfer when a form upload is repeated (reproduced over plain
+    HTTP/1.1 against aiohttp alone, so it is the client, not us)."""
+    base, img = h2_server
+    args = ["--http2", "--parallel", "--parallel-max", "8",
+            "-H", "Content-Type: image/jpeg"]
+    for i in range(6):
+        args += ["-o", str(tmp_path / f"p{i}.jpg"),
+                 "--data-binary", f"@{img}",
+                 base + f"/resize?width={100 + 10 * i}&height=80"]
+    r = _curl(args, timeout=120)
+    assert r.returncode == 0
+    from PIL import Image
+
+    for i in range(6):
+        assert Image.open(str(tmp_path / f"p{i}.jpg")).size == (100 + 10 * i, 80)
+
+
+def test_forwarded_identity_needs_hop_token(monkeypatch):
+    """The access log honors X-Forwarded-* ONLY with the per-process hop
+    token: a client-supplied XFF (from loopback or anywhere) must not
+    forge the logged peer, while the terminator's token-bearing hop must."""
+    import asyncio
+    import io
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from imaginary_tpu.web import accesslog
+    from imaginary_tpu.web.app import create_app
+    from imaginary_tpu.web.config import ServerOptions
+
+    monkeypatch.setattr(accesslog, "_TRUSTED_HOP_TOKEN", "")
+
+    async def scenario():
+        out = io.StringIO()
+        app = create_app(ServerOptions(), log_stream=out)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # 1) spoof without any token configured: ignored
+            await client.get("/health", headers={"X-Forwarded-For": "6.6.6.6"})
+            # 2) token configured, client spoofs XFF but not the token: ignored
+            accesslog.set_trusted_hop_token("sekrit")
+            await client.get("/health", headers={"X-Forwarded-For": "6.6.6.6"})
+            # 3) the real hop: token + XFF -> trusted
+            await client.get("/health", headers={
+                "X-Forwarded-For": "198.51.100.7",
+                "X-Forwarded-HTTP-Version": "2.0",
+                "X-Internal-Hop": "sekrit",
+            })
+        finally:
+            await client.close()
+        return out.getvalue().splitlines()
+
+    lines = asyncio.run(scenario())
+    assert "6.6.6.6" not in lines[0] and "6.6.6.6" not in lines[1]
+    assert "198.51.100.7" in lines[2] and "HTTP/2.0" in lines[2]
+
+
+def test_h2_active_respects_disable_flag():
+    from imaginary_tpu.web.app import _h2_active
+    from imaginary_tpu.web.config import ServerOptions
+
+    assert _h2_active(ServerOptions(http2=False)) is False
+    assert _h2_active(ServerOptions()) is _lib_present()
+
+
+def test_alpn_list_tracks_h2_support(tmp_path):
+    """make_ssl_context must never advertise a protocol the server cannot
+    speak: h2 appears iff the terminator is active."""
+    import ssl as ssl_mod
+
+    from imaginary_tpu.web.app import make_ssl_context
+    from imaginary_tpu.web.config import ServerOptions
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable")
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    o_on = ServerOptions(cert_file=cert, key_file=key, http2=True)
+    o_off = ServerOptions(cert_file=cert, key_file=key, http2=False)
+    assert isinstance(make_ssl_context(o_on), ssl_mod.SSLContext)
+    assert isinstance(make_ssl_context(o_off), ssl_mod.SSLContext)
+    # ALPN lists are write-only in the ssl module; negotiate against
+    # ourselves to observe the difference
+    for o, expect in ((o_on, "h2" if _lib_present() else "http/1.1"),
+                      (o_off, "http/1.1")):
+        server_ctx = make_ssl_context(o)
+        client_ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl_mod.CERT_NONE
+        client_ctx.set_alpn_protocols(["h2", "http/1.1"])
+        left, right = socket.socketpair()
+        try:
+            import threading
+
+            srv_result = {}
+
+            def srv():
+                try:
+                    s = server_ctx.wrap_socket(left, server_side=True)
+                    srv_result["alpn"] = s.selected_alpn_protocol()
+                    s.close()
+                except Exception as e:  # pragma: no cover
+                    srv_result["err"] = e
+
+            t = threading.Thread(target=srv)
+            t.start()
+            c = client_ctx.wrap_socket(right)
+            assert c.selected_alpn_protocol() == expect
+            c.close()
+            t.join(timeout=10)
+        finally:
+            left.close()
+            right.close()

@@ -113,12 +113,21 @@ def test_seed_link_rate_rejects_inverted_slope(monkeypatch):
     from imaginary_tpu.ops.plan import plan_operation
 
     monkeypatch.setattr(executor_mod, "_LINK_SEED", None)
-    monkeypatch.setattr(prewarm.chain_mod, "run_batch", lambda arrs, pls: None)
+
+    def stalled_small(arrs, pls):
+        # deterministic inversion: the SMALL drain (b=1) stalls, the big
+        # one returns instantly -> negative slope, guaranteed
+        import time as _t
+
+        if len(arrs) == 1:
+            _t.sleep(0.02)
+
+    monkeypatch.setattr(prewarm.chain_mod, "run_batch", stalled_small)
     small = plan_operation("resize", ImageOptions(width=24), 64, 96, 0, 3)
     big = plan_operation("resize", ImageOptions(width=300), 512, 768, 0, 3)
     assert prewarm._seed_link_rate(
         [(small, None, 64, 96, 1), (big, None, 512, 768, 2)]
-    ) is None  # both drains ~0 ms -> slope <= 0 -> unseeded
+    ) is None  # inverted slope -> unseeded
     assert executor_mod.link_seed() is None
 
 
