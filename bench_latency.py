@@ -247,6 +247,15 @@ async def main_async():
     port = int(os.environ.get("BENCH_PORT", "8899"))
 
     platform = os.environ.get("BENCH_PLATFORM", "")
+    if not platform:
+        from bench_util import probe_accelerator
+
+        if not probe_accelerator():
+            # a dying tunnel hangs inside the runtime (measured): without
+            # this gate the run is a 400-storm or a stall, not a benchmark
+            print("[lat] *** ACCELERATOR UNREACHABLE - CPU-JAX FALLBACK; "
+                  "this is NOT a TPU measurement ***", file=sys.stderr)
+            platform = "cpu"
     if platform:
         import jax
 

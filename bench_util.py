@@ -38,3 +38,20 @@ def pctl(lats, q: float) -> float:
         return 0.0
     s = sorted(lats)
     return round(s[min(len(s) - 1, int(q * (len(s) - 1)))], 2)
+
+
+def probe_accelerator(timeout: float = 90.0) -> bool:
+    """Device liveness check in a SUBPROCESS: a dying tunnel can hang
+    indefinitely inside the runtime (measured), and a hung bench is worse
+    than an honestly-labeled CPU bench."""
+    import subprocess
+    import sys
+
+    code = ("import jax; jax.devices(); import jax.numpy as jnp; "
+            "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False

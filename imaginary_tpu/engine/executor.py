@@ -120,6 +120,19 @@ class ExecutorConfig:
     # throughput policy, the breaker is an availability policy.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    # Drain-hang watchdog (the breaker's blind spot): a half-dead tunnel
+    # produces a MIX of instant errors — which the breaker counts — and
+    # calls that block inside the runtime forever, which it cannot: the
+    # drain never returns, no failure is booked, and every queued request
+    # rides its full client timeout (measured live against a dying axon
+    # tunnel: two instant empty-message 400s, then a hang that pinned the
+    # fetcher for minutes). After drain_watchdog_s the watchdog ABANDONS
+    # the drain: fails its futures fast, opens the breaker outright (a
+    # 20 s hang is unambiguous — no 3-strike debate), fails anything
+    # queued behind it, and hands the fetch loop to a fresh thread; the
+    # zombie drain's results are discarded if the call ever returns.
+    # 0 disables.
+    drain_watchdog_s: float = 20.0
 
 
 @dataclasses.dataclass
@@ -322,10 +335,26 @@ class Executor:
         # "never": the first probe slot is free — a fresh executor's rates
         # deserve a sample as soon as the count gate allows one
         self._last_shadow_t = float("-inf")
+        # Drain-hang watchdog state: (start_monotonic, chunks, gen) while
+        # a drain is in flight, None otherwise. _fetch_gen increments ONLY
+        # when the watchdog abandons a drain; a fetcher whose own gen no
+        # longer matches knows it is the zombie — it must discard whatever
+        # its blocked call eventually produced and exit, never touching
+        # the EWMAs, the breaker, inflight, or futures (the watchdog
+        # already failed them). Identity rides the GENERATION, not a
+        # shared boolean a replacement fetcher would reset.
+        self._drain_state = None
+        self._fetch_gen = 0
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
-        self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher", daemon=True)
+        self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher",
+                                         args=(0,), daemon=True)
         self._fetcher.start()
+        if self.config.drain_watchdog_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="itpu-watchdog", daemon=True
+            )
+            self._watchdog.start()
 
     # -- public API ------------------------------------------------------------
 
@@ -664,15 +693,90 @@ class Executor:
         # blocks when max_inflight groups are queued: natural backpressure
         self._fetch_queue.put((chunks, cold))
 
-    def _fetch_loop(self):
+    def _watchdog_loop(self):
+        """Abandon drains stuck past drain_watchdog_s (see ExecutorConfig).
+
+        All state transitions happen under _inflight_lock so the stuck
+        fetcher — whenever its call finally returns — observes exactly one
+        of {abandoned, not abandoned} and never double-books inflight or
+        double-resolves futures."""
+        budget = self.config.drain_watchdog_s
+        while self._running:
+            time.sleep(min(1.0, budget / 4))
+            with self._inflight_lock:
+                state = self._drain_state
+                if (
+                    state is None
+                    or state[2] != self._fetch_gen  # already abandoned
+                    or time.monotonic() - state[0] < budget
+                ):
+                    continue
+                _, chunks, _ = state
+                self._drain_state = None
+                self._fetch_gen += 1
+                gen = self._fetch_gen
+                self._inflight -= 1
+            err = RuntimeError(
+                f"device drain exceeded {budget:.0f}s watchdog; "
+                "link presumed hung"
+            )
+            for _, _, _, sub in chunks:
+                for it in sub:
+                    if not it.future.done():
+                        it.future.set_exception(err)
+            # a hung link is unambiguous: open the breaker outright so
+            # host-executable traffic fails over immediately
+            with self._owed_lock:
+                self._consec_device_failures = self.config.breaker_threshold
+                self.stats.device_failures += 1
+                if time.monotonic() >= self._breaker_open_until:
+                    self._breaker_open_until = (
+                        time.monotonic() + self.config.breaker_cooldown_s
+                    )
+                    self.stats.breaker_opens += 1
+            # groups queued behind the hung drain would block until the
+            # zombie thread unblocked (possibly never): fail them now
+            while True:
+                try:
+                    got = self._fetch_queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if got is None:
+                    self._fetch_queue.put(None)
+                    break
+                for _, _, _, sub in got[0]:
+                    for it in sub:
+                        if not it.future.done():
+                            it.future.set_exception(err)
+                with self._inflight_lock:
+                    self._inflight -= 1
+            # hand the queue to a fresh fetcher; the zombie exits when (if)
+            # its blocked call returns
+            self._fetcher = threading.Thread(
+                target=self._fetch_loop, name="itpu-fetcher", args=(gen,),
+                daemon=True,
+            )
+            self._fetcher.start()
+
+    def _fetch_loop(self, gen: int):
         while True:
             got = self._fetch_queue.get()
             if got is None:
                 break
+            with self._inflight_lock:
+                stale = self._fetch_gen != gen
+            if stale:
+                # a replacement fetcher owns the queue now; hand the item
+                # back (outside the lock: put() can block on the bounded
+                # queue) and exit
+                self._fetch_queue.put(got)
+                return
             chunks, cold = got
             n_items = sum(len(c[3]) for c in chunks)
             t0 = time.monotonic()
             t_ready = None
+            with self._inflight_lock:
+                self._drain_state = (t0, chunks, gen)
             try:
                 if self.config.split_drain_timing:
                     # diagnostic mode: sync compute first so the H2D+compute
@@ -681,13 +785,30 @@ class Executor:
                     t_ready = time.monotonic()
                 fetched = chain_mod.fetch_groups([c[0] for c in chunks])
             except Exception as e:
+                with self._inflight_lock:
+                    live = self._fetch_gen == gen
+                    if live:
+                        self._drain_state = None
+                if not live:
+                    return  # watchdog already failed the futures + inflight
                 self._note_device_failure()
                 for _, _, _, sub in chunks:
                     for it in sub:
-                        it.future.set_exception(e)
+                        if not it.future.done():
+                            it.future.set_exception(e)
                 with self._inflight_lock:
                     self._inflight -= 1
                 continue
+            with self._inflight_lock:
+                live = self._fetch_gen == gen
+                if live:
+                    self._drain_state = None
+            if not live:
+                # the watchdog gave up on this drain while the call was
+                # blocked: futures are failed, a replacement fetcher owns
+                # the queue — discard the zombie results and exit without
+                # touching the breaker, the EWMAs, or inflight
+                return
             self._note_device_ok()
             # A drain costs fixed + MB x rate (the link's round-trip floor
             # plus bandwidth). The per-MB estimator must book only the
@@ -751,10 +872,12 @@ class Executor:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)
                 except Exception as e:
                     for it in sub:
-                        it.future.set_exception(e)
+                        if not it.future.done():
+                            it.future.set_exception(e)
                     continue
                 for it, out in zip(sub, outs):
-                    it.future.set_result(out)
+                    if not it.future.done():  # watchdog may have failed it
+                        it.future.set_result(out)
             with self._inflight_lock:
                 self._inflight -= 1
 

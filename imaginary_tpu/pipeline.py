@@ -402,6 +402,7 @@ def _build_pipeline_plan(o, cur_h, cur_w, orientation, channels, src_type,
     the metadata carry must reset the Orientation tag exactly when the
     pixels were rotated, no more, no less.
     """
+    src_h0, src_w0 = cur_h, cur_w
     stages: list = []
     final_o = o
     target = _encode_type(o, src_type)
@@ -435,6 +436,9 @@ def _build_pipeline_plan(o, cur_h, cur_w, orientation, channels, src_type,
         final_o = op_opts
         if op_opts.type:
             target = _encode_type(op_opts, src_type)
+    from imaginary_tpu.ops.plan import fuse_adjacent_shrinking_samples
+
+    stages = fuse_adjacent_shrinking_samples(stages, src_h0, src_w0)
     return (ImagePlan(stages=stages, out_h=cur_h, out_w=cur_w), final_o,
             target, orientation_applied, strip)
 

@@ -168,3 +168,86 @@ def test_breaker_closes_on_device_success(monkeypatch):
         assert not ex._breaker_is_open()
     finally:
         ex.shutdown()
+
+
+class TestDrainWatchdog:
+    """The breaker's blind spot (measured live on a dying tunnel): a
+    half-dead link HANGS inside the runtime instead of erroring, so no
+    failure is ever booked and queued requests ride their full client
+    timeout. The watchdog abandons the stuck drain, fails its futures
+    fast, opens the breaker outright, and hands the queue to a fresh
+    fetcher; the zombie drain's results are discarded if the call ever
+    returns."""
+
+    def test_hung_drain_abandoned_breaker_opens_and_host_serves(self, monkeypatch):
+        import threading
+
+        from imaginary_tpu.engine import executor as ex_mod
+
+        release = threading.Event()
+        hung = threading.Event()
+
+        real_fetch = ex_mod.chain_mod.fetch_groups
+        calls = {"n": 0}
+
+        def hang_once(groups):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                hung.set()
+                release.wait(timeout=30)  # blocked "forever" (test-bounded)
+            return real_fetch(groups)
+
+        monkeypatch.setattr(ex_mod.chain_mod, "fetch_groups", hang_once)
+        ex = Executor(ExecutorConfig(window_ms=1, host_spill=False,
+                                     drain_watchdog_s=0.5,
+                                     breaker_cooldown_s=60))
+        try:
+            fut = ex.submit(_img(), _plan())
+            assert hung.wait(timeout=30)  # the drain is now stuck
+            with pytest.raises(RuntimeError, match="watchdog"):
+                fut.result(timeout=30)  # failed FAST, not at client timeout
+            assert ex.stats.breaker_opens == 1
+            assert ex.stats.device_failures >= 1
+            # host-executable traffic now fails over immediately
+            reset_placement()
+            out = ex.process(_img(seed=1), _plan(), timeout=30)
+            assert out.shape[0] > 0
+            assert last_placement() == "host"
+            assert ex.stats.breaker_host_served == 1
+            # zombie unblocks: its results are discarded without incident,
+            # and the replacement fetcher keeps serving once the breaker
+            # cooldown is behind us (simulate by closing it)
+            release.set()
+            with ex._owed_lock:
+                ex._breaker_open_until = 0.0
+                ex._consec_device_failures = 0
+            out2 = ex.process(_img(seed=2), _plan(), timeout=30)
+            assert out2.shape[0] > 0
+            assert calls["n"] >= 2  # replacement fetcher drained it
+        finally:
+            release.set()
+            ex.shutdown()
+
+    def test_groups_queued_behind_hung_drain_fail_fast(self, monkeypatch):
+        import threading
+
+        from imaginary_tpu.engine import executor as ex_mod
+
+        release = threading.Event()
+
+        def hang(groups):
+            release.wait(timeout=30)
+            raise RuntimeError("late failure")
+
+        monkeypatch.setattr(ex_mod.chain_mod, "fetch_groups", hang)
+        ex = Executor(ExecutorConfig(window_ms=1, host_spill=False,
+                                     drain_watchdog_s=0.5,
+                                     breaker_cooldown_s=60))
+        try:
+            futs = [ex.submit(_img(seed=i), _plan()) for i in range(3)]
+            for f in futs:
+                with pytest.raises(RuntimeError):
+                    f.result(timeout=30)
+        finally:
+            release.set()
+            ex.shutdown()
