@@ -136,17 +136,9 @@ def bench_baseline(buf: bytes, n_threads: int, duration: float,
 
 
 def _probe_accelerator(timeout: float = 90.0) -> bool:
-    """Check device liveness in a subprocess (the TPU tunnel can hang
-    indefinitely; a hung bench is worse than a CPU bench)."""
-    import subprocess
+    from bench_util import probe_accelerator
 
-    code = "import jax; jax.devices(); import jax.numpy as jnp; (jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()"
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    return probe_accelerator(timeout)
 
 
 def main():

@@ -40,16 +40,9 @@ def log(msg):
 
 
 def _probe_accelerator(timeout: float = 90.0) -> bool:
-    import subprocess
+    from bench_util import probe_accelerator
 
-    code = ("import jax; jax.devices(); import jax.numpy as jnp; "
-            "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    return probe_accelerator(timeout)
 
 
 def _med(xs):

@@ -725,15 +725,11 @@ class Executor:
                     if not it.future.done():
                         it.future.set_exception(err)
             # a hung link is unambiguous: open the breaker outright so
-            # host-executable traffic fails over immediately
+            # host-executable traffic fails over immediately (pre-load the
+            # consecutive count so the one shared transition site trips)
             with self._owed_lock:
-                self._consec_device_failures = self.config.breaker_threshold
-                self.stats.device_failures += 1
-                if time.monotonic() >= self._breaker_open_until:
-                    self._breaker_open_until = (
-                        time.monotonic() + self.config.breaker_cooldown_s
-                    )
-                    self.stats.breaker_opens += 1
+                self._consec_device_failures = self.config.breaker_threshold - 1
+            self._note_device_failure()
             # groups queued behind the hung drain would block until the
             # zombie thread unblocked (possibly never): fail them now
             while True:

@@ -697,7 +697,7 @@ def fuse_adjacent_shrinking_samples(stages: list, src_h: int, src_w: int) -> lis
     intervening stage (extract windows, embeds, transposes) block fusion.
     """
     out: list = []
-    entries: list = []  # dims entering each KEPT stage
+    prev_entry = None  # dims entering the most recently KEPT stage
     cur = (src_h, src_w)
     for st in stages:
         entry = cur
@@ -708,17 +708,16 @@ def fuse_adjacent_shrinking_samples(stages: list, src_h: int, src_w: int) -> lis
             and isinstance(out[-1].spec, SampleSpec)
             and out[-1].spec.kernel == st.spec.kernel
         ):
-            p_entry = entries[-1]
             p_dst = (int(out[-1].dyn["dst_h"]), int(out[-1].dyn["dst_w"]))
             dst = (int(st.dyn["dst_h"]), int(st.dyn["dst_w"]))
             if (
-                p_dst[0] <= p_entry[0] and p_dst[1] <= p_entry[1]
+                p_dst[0] <= prev_entry[0] and p_dst[1] <= prev_entry[1]
                 and dst[0] <= p_dst[0] and dst[1] <= p_dst[1]
             ):
-                out[-1] = st  # later stage already targets the final dims
-                continue
+                out[-1] = st  # later stage already targets the final dims;
+                continue      # prev_entry stays: the fused stage's entry
         out.append(st)
-        entries.append(entry)
+        prev_entry = entry
     return out
 
 

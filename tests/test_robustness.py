@@ -1,0 +1,155 @@
+"""Malformed-input robustness for the native codec layer.
+
+The C extension (native/codecs.cpp) is hand-written over libjpeg/libpng/
+libwebp with the raw YUV API, an EXIF parser, and JPEG segment splicing —
+the one place a bad byte could take down the whole server instead of
+returning a 400. These tests feed truncations and bit-flips of REAL
+encodes through every entry point; the contract is decode-or-ImageError,
+never a crash (a segfault would kill the pytest process, which IS the
+assertion), and never an unbounded hang.
+
+Ref analogue: the reference's error-path tests lean on libvips' own
+robustness (image_test.go feeds only valid fixtures); our layer is
+hand-rolled, so the burden is ours.
+"""
+
+import numpy as np
+import pytest
+
+from imaginary_tpu import codecs
+from imaginary_tpu.codecs import EncodeOptions
+from imaginary_tpu.errors import ImageError
+from imaginary_tpu.imgtype import ImageType
+
+
+def _mk(fmt: str) -> bytes:
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 256, (64, 96, 3), dtype=np.uint8)
+    return codecs.encode(arr, EncodeOptions(type=ImageType(fmt), quality=85))
+
+
+def _cuts(buf: bytes):
+    """Truncation points: every header byte, then strided body cuts."""
+    head = list(range(0, min(len(buf), 40)))
+    body = list(range(40, len(buf), max(1, len(buf) // 50)))
+    return head + body
+
+
+@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp"])
+def test_truncations_never_crash_decode(fmt):
+    buf = _mk(fmt)
+    ok = 0
+    for cut in _cuts(buf):
+        try:
+            d = codecs.decode(buf[:cut], 1)
+            assert d.array.ndim == 3
+            ok += 1
+        except ImageError:
+            pass
+    # sanity: the untruncated buffer decodes
+    assert codecs.decode(buf, 1).array.shape[:2] == (64, 96)
+
+
+@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp"])
+def test_bitflips_never_crash_decode(fmt):
+    buf = bytearray(_mk(fmt))
+    rng = np.random.default_rng(11)
+    for _ in range(80):
+        pos = int(rng.integers(0, len(buf)))
+        bit = 1 << int(rng.integers(0, 8))
+        mutated = bytes(buf[:pos]) + bytes([buf[pos] ^ bit]) + bytes(buf[pos + 1:])
+        try:
+            codecs.decode(mutated, 1)
+        except ImageError:
+            pass
+
+
+def test_probe_on_truncations_and_noise():
+    for fmt in ("jpeg", "png", "webp"):
+        buf = _mk(fmt)
+        for cut in _cuts(buf):
+            try:
+                m = codecs.probe(buf[:cut])
+                assert m.width >= 0 and m.height >= 0
+            except ImageError:
+                pass
+    rng = np.random.default_rng(5)
+    for n in (0, 1, 2, 3, 7, 11, 64, 4096):
+        blob = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        try:
+            codecs.probe(blob)
+        except ImageError:
+            pass
+
+
+def test_probe_fast_matches_probe_contract_on_garbage():
+    rng = np.random.default_rng(9)
+    for n in (0, 3, 12, 100, 2048):
+        blob = b"\xff\xd8\xff" + bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        for fn in (codecs.probe, codecs.probe_fast):
+            try:
+                fn(blob)
+            except ImageError:
+                pass
+
+
+@pytest.mark.skipif(not codecs.yuv420_supported(), reason="raw codec absent")
+def test_yuv_decode_truncations_never_crash():
+    from imaginary_tpu.ops.buckets import bucket_shape
+
+    buf = _mk("jpeg")
+    hb, wb = bucket_shape(64, 96)
+    for cut in _cuts(buf):
+        try:
+            codecs.decode_yuv420(buf[:cut], 1, hb, wb)
+        except (ImageError, ValueError):
+            pass
+    assert codecs.decode_yuv420(buf, 1, hb, wb) is not None
+
+
+def test_exif_carry_on_corrupt_exif_segments(testdata):
+    """Metadata splice must survive hostile APP1 payloads: the output is
+    either a clean JPEG with whatever could be carried, or the original
+    encode — never a crash."""
+    from imaginary_tpu.web import handlers  # noqa: F401  (import parity)
+    from tests.conftest import fixture_bytes
+
+    src = bytearray(fixture_bytes("exif-orient-6.jpg"))
+    # find the APP1 marker and shred its length/payload
+    i = src.find(b"\xff\xe1")
+    assert i > 0
+    from imaginary_tpu.pipeline import ProcessedImage, _carry_metadata
+
+    out = ProcessedImage(
+        body=codecs.encode(np.zeros((8, 8, 3), np.uint8),
+                           EncodeOptions(type=ImageType.JPEG)),
+        mime="image/jpeg",
+    )
+
+    for mutation in (
+        src[:i] + b"\xff\xe1\x00\x02" + src[i + 4:],        # empty segment
+        src[:i] + b"\xff\xe1\xff\xff" + src[i + 4:],        # huge length
+        src[:i + 4] + b"\x00" * 20 + src[i + 24:],          # zeroed TIFF head
+    ):
+        got = _carry_metadata(bytes(mutation), False, out, True, 8, 8)
+        assert bytes(got.body[:2]) == b"\xff\xd8"  # still a JPEG stream
+
+
+def test_pipeline_rejects_hostile_inputs_cleanly():
+    """End-to-end: random blobs through the full process path 400, never
+    crash the worker."""
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.pipeline import process_operation
+
+    rng = np.random.default_rng(17)
+    for n in (0, 1, 16, 512):
+        blob = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        with pytest.raises(ImageError):
+            process_operation("resize", blob, ImageOptions(width=32))
+    # valid magic, truncated body
+    jpg = _mk("jpeg")
+    for cut in (3, 20, len(jpg) // 2):
+        try:
+            process_operation("resize", jpg[:cut], ImageOptions(width=32))
+        except ImageError:
+            pass
