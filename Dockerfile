@@ -39,6 +39,7 @@ FROM python:3.12-slim-bookworm
 RUN apt-get update && apt-get install -y --no-install-recommends \
     libjpeg62-turbo libpng16-16 libwebp7 \
     librsvg2-2 libcairo2 libpoppler-glib8 libheif1 \
+    libnghttp2-14 \
     fonts-dejavu-core curl \
     && rm -rf /var/lib/apt/lists/*
 
