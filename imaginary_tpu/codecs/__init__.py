@@ -20,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from imaginary_tpu.errors import ImageError, new_error
+from imaginary_tpu.errors import ImageError
 from imaginary_tpu.imgtype import ImageType, determine_image_type
 
 

@@ -21,7 +21,6 @@ difference as libvips kernel selection vs other backends.
 
 from __future__ import annotations
 
-import math
 
 import numpy as np
 

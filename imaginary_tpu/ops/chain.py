@@ -15,7 +15,6 @@ f32); conversion to f32 happens on device and output returns as uint8.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

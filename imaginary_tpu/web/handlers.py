@@ -11,7 +11,6 @@ max-allowed-resolution guard, and `-return-size` headers.
 from __future__ import annotations
 
 import asyncio
-import json
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
@@ -36,9 +35,8 @@ from imaginary_tpu.imgtype import (
     ImageType,
     is_image_mime_type_supported,
 )
-from imaginary_tpu.options import ImageOptions
 from imaginary_tpu.params import ParamError, build_params_from_query
-from imaginary_tpu.pipeline import ALL_OPERATIONS, process_operation
+from imaginary_tpu.pipeline import process_operation
 from imaginary_tpu.version import current_versions
 from imaginary_tpu.web.config import ServerOptions
 from imaginary_tpu.web.health import get_health_stats
