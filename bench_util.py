@@ -55,3 +55,33 @@ def probe_accelerator(timeout: float = 90.0) -> bool:
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def run_workers(call, duration: float, n_threads: int):
+    """Closed-loop thread harness shared by the bench scripts: run
+    call(worker_index, iteration) for `duration` seconds across
+    `n_threads`, returning (ops_per_sec, flat_latency_ms_list)."""
+    import threading
+    import time
+
+    stop = time.monotonic() + duration
+    lats: list = [[] for _ in range(n_threads)]
+    counts = [0] * n_threads
+
+    def worker(k):
+        i = k
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            call(k, i)
+            lats[k].append((time.monotonic() - t0) * 1000.0)
+            counts[k] += 1
+            i += n_threads
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    return sum(counts) / elapsed, [x for sub in lats for x in sub]
