@@ -21,6 +21,46 @@ from imaginary_tpu.web.config import (
 )
 
 
+def _start_device_probe():
+    """Launch the backend liveness probe as a SUBPROCESS (a dead tunnel
+    hangs indefinitely inside the runtime, so liveness cannot be checked
+    in-process) and return immediately: the parent's bootstrap (imports,
+    cache setup) overlaps the child's jax init instead of serializing
+    behind it. On plain-CPU hosts the probe trivially succeeds — it
+    guards against hangs, not against CPU backends."""
+    import subprocess
+
+    code = ("import jax; jax.devices(); import jax.numpy as jnp; "
+            "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()")
+    try:
+        return subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE)
+    except Exception:
+        return None
+
+
+def _finish_device_probe(proc, timeout: float = 75.0):
+    """Join the probe: (alive, diagnostic). The child's stderr rides back
+    so a refusal names the actual cause, not just 'unreachable'."""
+    if proc is None:
+        return False, "probe process could not be started"
+    import subprocess
+
+    try:
+        _, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, f"probe hung for {timeout:.0f}s inside the runtime"
+    except Exception as e:
+        return False, str(e)
+    if proc.returncode == 0:
+        return True, ""
+    tail = (err or b"").decode(errors="replace").strip().splitlines()
+    return False, tail[-1][-300:] if tail else f"probe exit {proc.returncode}"
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="imaginary-tpu",
@@ -47,6 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-allowed-resolution", type=float, default=18.0, help="max megapixels")
     p.add_argument("--certfile", default="")
     p.add_argument("--keyfile", default="")
+    p.add_argument("--require-device", action="store_true",
+                   help="refuse to start when the accelerator is unreachable "
+                        "(default: fall back to the CPU backend with a warning)")
     p.add_argument("--disable-http2", action="store_true",
                    help="serve http/1.1 only over TLS (h2 is on by default, like the reference)")
     p.add_argument("--authorization", default="", help="fixed Authorization header for origins")
@@ -181,6 +224,18 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", platform)
 
+    # Boot-time device liveness gate. A dead/hung accelerator tunnel
+    # blocks INSIDE the runtime at first use — prewarm or the first
+    # request would hang the whole boot with no error (the runtime
+    # watchdog covers hangs after boot, not during it). The probe runs
+    # when no platform pin made the backend an explicit operator choice,
+    # and ALWAYS when --require-device asks for the guarantee (a pinned
+    # platform can still be a dead tunnel). It starts now as a subprocess
+    # and is joined after the rest of the bootstrap, before prewarm/serve.
+    probe_proc = None
+    if args.require_device or (not platform and not o.distributed):
+        probe_proc = _start_device_probe()
+
     if o.distributed:
         # must run before any jax backend initialization so every process
         # sees the global device set (SURVEY.md section 5.8)
@@ -206,6 +261,22 @@ def main(argv=None) -> int:
         atexit.register(stop_profiler)
 
     from imaginary_tpu.web.app import serve
+
+    if probe_proc is not None:
+        alive, diag = _finish_device_probe(probe_proc)
+        if not alive:
+            if args.require_device:
+                print("imaginary-tpu: accelerator unreachable and "
+                      f"--require-device is set; refusing to start ({diag})",
+                      file=sys.stderr)
+                return 2
+            # availability-first default: the host SIMD path serves every
+            # host-executable op, and the reference itself is CPU-only
+            print("imaginary-tpu: WARNING - accelerator unreachable "
+                  f"({diag}); serving on the CPU backend", file=sys.stderr)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
 
     if o.prewarm:
         from imaginary_tpu.prewarm import prewarm_common_chains

@@ -583,3 +583,68 @@ class TestTLSConfig:
         from imaginary_tpu.web.app import make_ssl_context
 
         assert make_ssl_context(ServerOptions(cert_file="/tmp/x.crt")) is None
+
+
+class TestBootLivenessGate:
+    """A dead/hung accelerator tunnel blocks INSIDE the runtime at first
+    use; the CLI probes liveness in a subprocess before serving and
+    either falls back to CPU loudly or dies cleanly (--require-device)."""
+
+    def test_require_device_refuses_to_start(self, monkeypatch):
+        from imaginary_tpu import cli
+
+        # the gate only runs when no platform pin is present (a pinned
+        # platform is an explicit operator decision); the test env pins
+        # cpu, so clear it
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("IMAGINARY_TPU_PLATFORM", raising=False)
+        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_finish_device_probe",
+                            lambda p, timeout=75.0: (False, "link down"))
+        assert cli.main(["--require-device", "--port", "0"]) == 2
+
+    def test_default_falls_back_to_cpu(self, monkeypatch):
+        import jax
+
+        from imaginary_tpu import cli
+        from imaginary_tpu.web import app as app_mod
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("IMAGINARY_TPU_PLATFORM", raising=False)
+        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_finish_device_probe",
+                            lambda p, timeout=75.0: (False, "link down"))
+
+        served = {}
+
+        async def fake_serve(o, mrelease=30):
+            served["platform"] = jax.config.jax_platforms
+
+        monkeypatch.setattr(app_mod, "serve", fake_serve)
+        before = jax.config.jax_platforms
+        try:
+            assert cli.main(["--port", "0"]) == 0
+            assert served["platform"] == "cpu"  # loud CPU fallback engaged
+        finally:
+            jax.config.update("jax_platforms", before or "cpu")
+
+    def test_probe_times_out_cleanly(self):
+        from imaginary_tpu import cli
+
+        # 50 ms is far below any real jax import: the subprocess probe
+        # must time out and report dead with a diagnostic, not hang
+        alive, diag = cli._finish_device_probe(cli._start_device_probe(),
+                                               timeout=0.05)
+        assert alive is False
+        assert "hung" in diag
+
+    def test_require_device_probes_even_with_platform_pin(self, monkeypatch):
+        """A pinned platform is an operator choice of BACKEND, not proof
+        of liveness: --require-device must still verify it."""
+        from imaginary_tpu import cli
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_finish_device_probe",
+                            lambda p, timeout=75.0: (False, "pinned but dead"))
+        assert cli.main(["--require-device", "--port", "0"]) == 2
