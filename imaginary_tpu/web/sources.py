@@ -40,7 +40,11 @@ WATERMARK_MAX_BYTES = 1_000_000  # ref: image.go:352
 
 class BodyImageSource:
     """POST/PUT payloads: multipart `file` field or raw body
-    (ref: source_body.go:30-100)."""
+    (ref: source_body.go:30-100). The `?field=` override selects a
+    custom multipart field name — the reference DOCUMENTS this
+    (README.md:597 "Custom image form field name ... Defaults to: file")
+    but its fork hard-codes `file` (source_body.go:12, SURVEY 2.13);
+    this build follows the documented contract."""
 
     name = "payload"
 
@@ -54,9 +58,10 @@ class BodyImageSource:
         return await self._read_raw(request)
 
     async def _read_form(self, request: web.Request) -> bytes:
+        field = request.query.get("field", FORM_FIELD) or FORM_FIELD
         reader = await request.multipart()
         async for part in reader:
-            if part.name == FORM_FIELD:
+            if part.name == field:
                 data = bytearray()
                 while True:
                     chunk = await part.read_chunk(1 << 16)

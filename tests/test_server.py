@@ -585,6 +585,41 @@ class TestTLSConfig:
         assert make_ssl_context(ServerOptions(cert_file="/tmp/x.crt")) is None
 
 
+class TestMultipartFieldOverride:
+    """?field= selects the multipart form field name — documented by the
+    reference (README.md:597, default `file`) though its fork hard-codes
+    `file` (source_body.go:12); this build follows the docs."""
+
+    def test_custom_field_name_accepted(self):
+        async def fn(client, _):
+            form = FormData()
+            form.add_field("photo", fixture_bytes("imaginary.jpg"),
+                           filename="p.jpg", content_type="image/jpeg")
+            r = await client.post("/resize?width=100&field=photo", data=form)
+            assert r.status == 200
+            assert oracle_size(await r.read())[0] == 100
+
+        run(ServerOptions(), fn)
+
+    def test_default_field_still_file(self):
+        async def fn(client, _):
+            r = await client.post("/resize?width=100", data=multipart_jpg())
+            assert r.status == 200
+
+        run(ServerOptions(), fn)
+
+    def test_wrong_field_is_missing_file_error(self):
+        async def fn(client, _):
+            form = FormData()
+            form.add_field("photo", fixture_bytes("imaginary.jpg"),
+                           filename="p.jpg", content_type="image/jpeg")
+            # no ?field= -> the `photo` part is invisible, like the ref
+            r = await client.post("/resize?width=100", data=form)
+            assert r.status == 400
+
+        run(ServerOptions(), fn)
+
+
 class TestBootLivenessGate:
     """A dead/hung accelerator tunnel blocks INSIDE the runtime at first
     use; the CLI probes liveness in a subprocess before serving and
