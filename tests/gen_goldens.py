@@ -46,6 +46,62 @@ MATRIX = [
 SMARTCROP = ("smartcrop_300x260", "smartcrop", {"width": 300, "height": 260},
              (300, 260))
 
+# Multi-op /pipeline chains: pins the COMBINED plan end-to-end across the
+# three resample topologies — FUSED (crop whose target aspect matches the
+# source plans a pure cover-resize, so crop+resize collapse into ONE
+# direct sample: the r4 adjacent-resample fusion), EXTRACT-BLOCKED (crop
+# with an aspect-mismatched window keeps Sample->Extract->Sample), and
+# SINGLE-SAMPLE (rotate+thumbnail: nothing to fuse). n_samples is
+# asserted at generation AND grading time so a fusion regression is
+# caught as a plan-shape change, not just pixel drift. Expected dims
+# derive from the reference's per-op semantics on the 550x740 fixture.
+PIPELINES = [
+    ("pipeline_fused_crop_resize",
+     [{"operation": "crop", "params": {"width": 440, "height": 592}},
+      {"operation": "resize", "params": {"width": 240}},
+      {"operation": "blur", "params": {"sigma": 1.5}},
+      {"operation": "convert", "params": {"type": "png"}}],
+     (240, 323), 1),
+    ("pipeline_crop_resize_blur",
+     [{"operation": "crop", "params": {"width": 480, "height": 360}},
+      {"operation": "resize", "params": {"width": 240}},
+      {"operation": "blur", "params": {"sigma": 1.5}},
+      {"operation": "convert", "params": {"type": "png"}}],
+     (240, 180), 2),
+    ("pipeline_rotate_thumbnail",
+     [{"operation": "rotate", "params": {"rotate": 90}},
+      {"operation": "thumbnail", "params": {"width": 120}},
+      {"operation": "convert", "params": {"type": "png"}}],
+     (120, 89), 1),
+]
+
+
+def _pipeline_sample_count(ops: list, src_h: int = 740, src_w: int = 550) -> int:
+    import json as _json
+
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.params import parse_json_operations
+    from imaginary_tpu.pipeline import _build_pipeline_plan
+    from imaginary_tpu.ops.stages import SampleSpec
+
+    o = ImageOptions(operations=parse_json_operations(_json.dumps(ops)))
+    plan, *_ = _build_pipeline_plan(o, src_h, src_w, 0, 3, None, None)
+    return sum(isinstance(st.spec, SampleSpec) for st in plan.stages)
+
+
+def _run_pipeline_case(buf: bytes, ops: list):
+    import json as _json
+
+    from PIL import Image
+
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.params import parse_json_operations
+    from imaginary_tpu.pipeline import process_pipeline
+
+    o = ImageOptions(operations=parse_json_operations(_json.dumps(ops)))
+    out = process_pipeline(buf, o)
+    return np.asarray(Image.open(io.BytesIO(out.body)).convert("RGB"))
+
 
 def _setup_cpu():
     import jax
@@ -126,6 +182,13 @@ def generate_all(out_dir: str = GOLDEN_DIR) -> None:
         assert (arr.shape[1], arr.shape[0]) == expect_wh, (name, arr.shape)
         Image.fromarray(arr).save(os.path.join(out_dir, f"{name}.png"))
         print(f"golden {name}: {arr.shape[1]}x{arr.shape[0]}")
+
+    for name, ops, expect_wh, n_samples in PIPELINES:
+        assert _pipeline_sample_count(ops) == n_samples, (name, "plan shape")
+        arr = _run_pipeline_case(jpg, ops)
+        assert (arr.shape[1], arr.shape[0]) == expect_wh, (name, arr.shape)
+        Image.fromarray(arr).save(os.path.join(out_dir, f"{name}.png"))
+        print(f"golden {name}: {arr.shape[1]}x{arr.shape[0]} samples={n_samples}")
 
     name, op, kw, expect_wh = SMARTCROP
     arr = _run_case(smart, op, kw)

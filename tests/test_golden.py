@@ -25,7 +25,9 @@ import numpy as np
 import pytest
 from PIL import Image
 
-from tests.gen_goldens import GOLDEN_DIR, MATRIX, SMARTCROP, _run_case, _smartcrop_window
+from tests.gen_goldens import (GOLDEN_DIR, MATRIX, PIPELINES, SMARTCROP,
+                               _pipeline_sample_count, _run_case,
+                               _run_pipeline_case, _smartcrop_window)
 from tests.conftest import fixture_bytes, psnr as _psnr
 
 
@@ -47,21 +49,36 @@ class TestFitDimensionTable:
         assert _fit_dims(iw, ih, ow, oh) == (fw, fh)
 
 
+def _grade_against_golden(name, arr, expect_wh):
+    """The golden contract in one place: the committed file is REQUIRED
+    (missing means gen_goldens.py wasn't re-run after adding a row —
+    fail, don't skip), dims must match the reference's assertSize
+    expectations, and pixels must stay within the 45 dB drift floor."""
+    golden_path = os.path.join(GOLDEN_DIR, f"{name}.png")
+    assert os.path.exists(golden_path), f"missing golden {name} — run gen_goldens.py"
+    assert (arr.shape[1], arr.shape[0]) == expect_wh
+    golden = np.asarray(Image.open(golden_path).convert("RGB"))
+    assert golden.shape == arr.shape
+    p = _psnr(arr, golden)
+    assert p >= 45.0, f"{name}: drifted from golden, PSNR {p:.1f} dB"
+
+
 class TestGoldenMatrix:
     @pytest.mark.parametrize("name,op,kw,expect_wh", MATRIX,
                              ids=[m[0] for m in MATRIX])
     def test_dims_and_pixels(self, name, op, kw, expect_wh):
-        # committed goldens are required: missing means gen_goldens.py
-        # wasn't re-run after adding a matrix row — fail, don't skip
-        golden_path = os.path.join(GOLDEN_DIR, f"{name}.png")
-        assert os.path.exists(golden_path), f"missing golden {name} — run gen_goldens.py"
         arr = _run_case(fixture_bytes("imaginary.jpg"), op, kw)
-        # dimension parity with the reference's assertSize expectations
-        assert (arr.shape[1], arr.shape[0]) == expect_wh
-        golden = np.asarray(Image.open(golden_path).convert("RGB"))
-        assert golden.shape == arr.shape
-        p = _psnr(arr, golden)
-        assert p >= 45.0, f"{name}: drifted from golden, PSNR {p:.1f} dB"
+        _grade_against_golden(name, arr, expect_wh)
+
+    @pytest.mark.parametrize("name,ops,expect_wh,n_samples", PIPELINES,
+                             ids=[p[0] for p in PIPELINES])
+    def test_pipeline_dims_and_pixels(self, name, ops, expect_wh, n_samples):
+        """Combined-plan goldens across the three resample topologies:
+        fused / extract-blocked / single-sample. The plan-shape assert
+        catches a fusion regression even when pixels stay in tolerance."""
+        assert _pipeline_sample_count(ops) == n_samples
+        arr = _run_pipeline_case(fixture_bytes("imaginary.jpg"), ops)
+        _grade_against_golden(name, arr, expect_wh)
 
     def test_smartcrop_golden(self):
         name, op, kw, expect_wh = SMARTCROP
