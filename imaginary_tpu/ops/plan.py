@@ -192,8 +192,18 @@ class _Planner:
         self.h, self.w = self.w, self.h
 
     def rotate(self, angle: int):
-        """Exact 90-degree-family rotation; angle is degrees clockwise."""
-        angle = angle % 360
+        """Exact 90-degree-family rotation; angle is degrees clockwise.
+
+        Non-multiples FLOOR to the lower 90 multiple (135 -> 90,
+        275 -> 270), matching bimg's calculateRotationAngle — vips_rot
+        supports only the D90 family and the reference's rotate rides
+        bimg, so rotate=135 must turn the image, not no-op. No mod-360
+        wrap: bimg never wraps, so angles outside the D90 family after
+        flooring (450 -> 450) fall through its getAngle default of D0 —
+        an out-of-range rotate is a re-encode, not a turn. (Negative
+        angles cannot reach here: the params layer takes absolute values,
+        like the reference's parseInt.)"""
+        angle -= angle % 90
         if angle == 90:
             self.transpose()
             self.flop()
@@ -203,8 +213,6 @@ class _Planner:
         elif angle == 270:
             self.transpose()
             self.flip()
-        # other angles: not a 90-multiple; vips_rot supports only D90 family
-        # (arbitrary-angle similarity is a later milestone)
 
     def exif_orient(self, orientation: int):
         """EXIF orientation -> upright (ref: image.go:155-179 table)."""

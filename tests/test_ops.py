@@ -431,3 +431,22 @@ class TestFontResolution:
             pytest.skip("no ttf fonts on host")
         f = _load_font("sans 14", 72)
         assert isinstance(f, ImageFont.FreeTypeFont)
+
+
+class TestRotateAngleFlooring:
+    """bimg floors arbitrary angles to the lower 90 multiple
+    (calculateRotationAngle); rotate=135 must turn the image, not no-op."""
+
+    @pytest.mark.parametrize("angle,expect_wh", [
+        (45, (550, 740)),    # floors to 0: identity
+        (135, (740, 550)),   # floors to 90
+        (225, (550, 740)),   # floors to 180
+        (275, (740, 550)),   # floors to 270
+        (450, (550, 740)),   # out of range: bimg never wraps -> D0 no-op
+    ])
+    def test_floors_like_bimg(self, angle, expect_wh):
+        o = ImageOptions(rotate=angle)
+        o.mark_defined("rotate")
+        out = process_operation("rotate", fixture_bytes("imaginary.jpg"), o)
+        im = Image.open(io.BytesIO(out.body))
+        assert im.size == expect_wh
