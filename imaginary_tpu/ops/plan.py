@@ -194,15 +194,18 @@ class _Planner:
     def rotate(self, angle: int):
         """Exact 90-degree-family rotation; angle is degrees clockwise.
 
-        Non-multiples FLOOR to the lower 90 multiple (135 -> 90,
-        275 -> 270), matching bimg's calculateRotationAngle — vips_rot
-        supports only the D90 family and the reference's rotate rides
-        bimg, so rotate=135 must turn the image, not no-op. No mod-360
-        wrap: bimg never wraps, so angles outside the D90 family after
-        flooring (450 -> 450) fall through its getAngle default of D0 —
-        an out-of-range rotate is a re-encode, not a turn. (Negative
-        angles cannot reach here: the params layer takes absolute values,
-        like the reference's parseInt.)"""
+        In-range non-multiples FLOOR to the lower 90 multiple (135 -> 90,
+        275 -> 270): vips_rot supports only the D90 family and bimg
+        floors before dispatching, so rotate=135 must turn the image,
+        not no-op. Outside [90, 359] the reference's exact behavior is
+        UNVERIFIABLE here (bimg's source is not on this zero-egress
+        system; the README documents only 90/180/270): this build
+        no-ops — for negatives that agrees with every plausible bimg
+        reading (Go's -90 % 90 == 0 leaves the angle outside the D90
+        switch), for >= 360 it is the conservative re-encode choice.
+        Negative values CAN arrive via pipeline JSON params (the
+        query-string layer abs()es, the JSON layer does not — same as
+        the reference's split)."""
         angle -= angle % 90
         if angle == 90:
             self.transpose()

@@ -234,9 +234,15 @@ class TestDrainWatchdog:
         from imaginary_tpu.engine import executor as ex_mod
 
         release = threading.Event()
+        calls = {"n": 0}
 
         def hang(groups):
-            release.wait(timeout=30)
+            # only the FIRST drain hangs; any group the collector was
+            # still holding when the watchdog drained the queue lands on
+            # the REPLACEMENT fetcher, which must fail it fast, not block
+            calls["n"] += 1
+            if calls["n"] == 1:
+                release.wait(timeout=30)
             raise RuntimeError("late failure")
 
         monkeypatch.setattr(ex_mod.chain_mod, "fetch_groups", hang)

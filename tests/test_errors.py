@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from imaginary_tpu.errors import ErrNotFound, ImageError, new_error
 
 
@@ -29,3 +31,37 @@ def test_http_code_clamped():
 def test_predefined():
     assert ErrNotFound.code == 404
     assert isinstance(ErrNotFound, ImageError)
+
+
+class TestRequiredParamMessages:
+    """The per-op required-param guards, graded against the reference's
+    EXACT wire messages (image.go:115-310) — clients match on these."""
+
+    CASES = [
+        ("resize", {}, "Missing required param: height or width"),
+        ("enlarge", {"width": 400}, "Missing required params: height, width"),
+        ("extract", {"top": 10}, "Missing required params: areawidth or areaheight"),
+        ("crop", {}, "Missing required param: height or width"),
+        ("smartcrop", {}, "Missing required param: height or width"),
+        ("rotate", {}, "Missing required param: rotate"),
+        ("zoom", {}, "Missing required param: factor"),
+        ("zoom", {"factor": 2, "top": 10},
+         "Missing required params: areawidth, areaheight"),
+        ("convert", {}, "Missing required param: type"),
+        ("blur", {}, "Missing required param: sigma or minampl"),
+    ]
+
+    @pytest.mark.parametrize("op,kw,msg", CASES,
+                             ids=[f"{c[0]}-{i}" for i, c in enumerate(CASES)])
+    def test_exact_message(self, op, kw, msg):
+        from imaginary_tpu.options import ImageOptions
+        from imaginary_tpu.pipeline import process_operation
+        from tests.conftest import fixture_bytes
+
+        o = ImageOptions(**kw)
+        for k in kw:
+            o.mark_defined(k)
+        with pytest.raises(ImageError) as ei:
+            process_operation(op, fixture_bytes("imaginary.jpg"), o)
+        assert ei.value.message == msg
+        assert ei.value.http_code() == 400

@@ -442,7 +442,7 @@ class TestRotateAngleFlooring:
         (135, (740, 550)),   # floors to 90
         (225, (550, 740)),   # floors to 180
         (275, (740, 550)),   # floors to 270
-        (450, (550, 740)),   # out of range: bimg never wraps -> D0 no-op
+        (450, (550, 740)),   # >=360: unverifiable vs bimg -> conservative no-op
     ])
     def test_floors_like_bimg(self, angle, expect_wh):
         o = ImageOptions(rotate=angle)
@@ -450,3 +450,16 @@ class TestRotateAngleFlooring:
         out = process_operation("rotate", fixture_bytes("imaginary.jpg"), o)
         im = Image.open(io.BytesIO(out.body))
         assert im.size == expect_wh
+
+    def test_negative_rotate_via_pipeline_json_noops(self):
+        """Negatives reach the planner only through pipeline JSON (the
+        query layer abs()es); every plausible bimg reading no-ops them."""
+        ops = json.dumps([
+            {"operation": "rotate", "params": {"rotate": -90}},
+            {"operation": "convert", "params": {"type": "png"}},
+        ])
+        o = build_params_from_query({"operations": ops})
+        from imaginary_tpu.pipeline import process_pipeline
+
+        out = process_pipeline(fixture_bytes("imaginary.jpg"), o)
+        assert Image.open(io.BytesIO(out.body)).size == (550, 740)  # unrotated
