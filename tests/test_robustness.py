@@ -153,3 +153,30 @@ def test_pipeline_rejects_hostile_inputs_cleanly():
             process_operation("resize", jpg[:cut], ImageOptions(width=32))
         except ImageError:
             pass
+
+
+def test_vector_decode_truncations_never_crash(testdata):
+    """SVG (librsvg) and PDF (poppler) ride ctypes over C libraries; a
+    hostile byte must produce ImageError/406, never a crash. Skips
+    quietly where a loader library is absent (the decode itself raises
+    ImageError then, which still satisfies the contract)."""
+    from tests.conftest import fixture_bytes
+
+    for fixture in ("button.svg", "page.pdf"):
+        buf = fixture_bytes(fixture)
+        for cut in _cuts(buf):
+            try:
+                codecs.decode(buf[:cut], 1)
+            except ImageError:
+                pass
+    # strided bit-flips on the full files
+    rng = np.random.default_rng(23)
+    for fixture in ("button.svg", "page.pdf"):
+        buf = bytearray(fixture_bytes(fixture))
+        for _ in range(40):
+            pos = int(rng.integers(0, len(buf)))
+            mutated = bytes(buf[:pos]) + bytes([buf[pos] ^ 0x41]) + bytes(buf[pos + 1:])
+            try:
+                codecs.decode(mutated, 1)
+            except ImageError:
+                pass
