@@ -239,3 +239,29 @@ def test_alpn_list_tracks_h2_support(tmp_path):
         finally:
             left.close()
             right.close()
+
+
+def test_h2_connection_churn_no_leak(h2_server):
+    """100 short-lived h2 connections: every nghttp2 session, callback
+    set, and stream state must be freed on connection_lost — the server's
+    RSS must not grow materially with connection count."""
+    base, img = h2_server
+
+    def rss_mb():
+        r = _curl(["-o", "-", base + "/health"])
+        import json as _json
+
+        return float(_json.loads(r.stdout)["allocatedMemoryMb"])
+
+    # warm a few connections first so allocator pools settle
+    for _ in range(10):
+        _curl(["--http2", "-o", "/dev/null", base + "/health"])
+    before = rss_mb()
+    for _ in range(100):
+        r = _curl(["--http2", "-o", "/dev/null", "-w", "%{http_code}",
+                   base + "/health"])
+        assert r.stdout == b"200"
+    after = rss_mb()
+    # 100 connections x (session + callbacks + buffers) would show up in
+    # tens of MB if leaked; allow generous noise for GC timing
+    assert after - before < 30.0, f"RSS grew {after - before:.1f} MB over 100 conns"
