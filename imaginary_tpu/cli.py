@@ -21,17 +21,31 @@ from imaginary_tpu.web.config import (
 )
 
 
-def _start_device_probe():
+def _start_device_probe(platform: str = "", require_accel: bool = False):
     """Launch the backend liveness probe as a SUBPROCESS (a dead tunnel
     hangs indefinitely inside the runtime, so liveness cannot be checked
     in-process) and return immediately: the parent's bootstrap (imports,
     cache setup) overlaps the child's jax init instead of serializing
-    behind it. On plain-CPU hosts the probe trivially succeeds — it
-    guards against hangs, not against CPU backends."""
+    behind it.
+
+    The child runs the SAME backend the server will: a pinned platform is
+    re-pinned via jax.config in the child (the tunnel plugin
+    force-registers at interpreter boot and overrides the JAX_PLATFORMS
+    env var — measured: env-pinned cpu still hangs on a dead tunnel;
+    config-pinned does not). With require_accel, a clean fall-back to the
+    CPU backend (plugin absent, or failing without a hang) is a probe
+    FAILURE — jax silently degrades to CPU, so liveness alone would pass
+    and the server would boot on CPU despite --require-device."""
     import subprocess
 
-    code = ("import jax; jax.devices(); import jax.numpy as jnp; "
+    pin = (f"jax.config.update('jax_platforms', {platform!r}); "
+           if platform else "")
+    code = (f"import jax; {pin}ds = jax.devices(); import jax.numpy as jnp; "
             "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready()")
+    if require_accel:
+        code += ("; assert ds[0].platform != 'cpu', "
+                 "'only the CPU backend initialized (accelerator plugin "
+                 "absent or failed cleanly)'")
     try:
         return subprocess.Popen([sys.executable, "-c", code],
                                 stdout=subprocess.DEVNULL,
@@ -231,10 +245,13 @@ def main(argv=None) -> int:
     # when no platform pin made the backend an explicit operator choice,
     # and ALWAYS when --require-device asks for the guarantee (a pinned
     # platform can still be a dead tunnel). It starts now as a subprocess
-    # and is joined after the rest of the bootstrap, before prewarm/serve.
+    # — on the same platform pin the server will use, asserting a non-CPU
+    # device under --require-device — and is joined after the rest of the
+    # bootstrap, before prewarm/serve.
     probe_proc = None
     if args.require_device or (not platform and not o.distributed):
-        probe_proc = _start_device_probe()
+        probe_proc = _start_device_probe(platform=platform,
+                                         require_accel=args.require_device)
 
     if o.distributed:
         # must run before any jax backend initialization so every process

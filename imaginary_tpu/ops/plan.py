@@ -195,18 +195,16 @@ class _Planner:
         """Exact 90-degree-family rotation; angle is degrees clockwise.
 
         In-range non-multiples FLOOR to the lower 90 multiple (135 -> 90,
-        275 -> 270): vips_rot supports only the D90 family and bimg
-        floors before dispatching, so rotate=135 must turn the image,
-        not no-op. Outside [90, 359] the reference's exact behavior is
-        UNVERIFIABLE here (bimg's source is not on this zero-egress
-        system; the README documents only 90/180/270): this build
-        no-ops — for negatives that agrees with every plausible bimg
-        reading (Go's -90 % 90 == 0 leaves the angle outside the D90
-        switch), for >= 360 it is the conservative re-encode choice.
-        Negative values CAN arrive via pipeline JSON params (the
-        query-string layer abs()es, the JSON layer does not — same as
-        the reference's split)."""
+        275 -> 270): vips_rot supports only the D90 family and bimg's
+        getAngle (resizer.go) floors before dispatching, so rotate=135
+        must turn the image, not no-op. Above the family getAngle clamps
+        with min(angle, 270), so rotate=450 rotates 270. Negatives no-op
+        (Go's -90 % 90 == 0 leaves the angle outside the D90 switch) —
+        they CAN arrive via pipeline JSON params (the query-string layer
+        abs()es, the JSON layer does not — same as the reference's
+        split)."""
         angle -= angle % 90
+        angle = min(angle, 270)
         if angle == 90:
             self.transpose()
             self.flop()

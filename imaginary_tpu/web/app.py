@@ -141,77 +141,98 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
     ssl_ctx = make_ssl_context(o)
     h2_server = None
     h2_client = None
-    if ssl_ctx is not None and _h2_active(o):
-        # HTTP/2 termination (web/http2.py): an internal loopback h1
-        # listener serves BOTH protocols' requests — h2 streams are
-        # decoded by nghttp2 and forwarded one hop so middleware,
-        # handlers, and access log never fork behavior by protocol.
-        import secrets
+    hop_dir = None
+    try:
+        if ssl_ctx is not None and _h2_active(o):
+            # HTTP/2 termination (web/http2.py): an internal h1 listener
+            # serves BOTH protocols' requests — h2 streams are decoded by
+            # nghttp2 and forwarded one hop so middleware, handlers, and
+            # access log never fork behavior by protocol. The hop rides a
+            # Unix domain socket in a mode-0700 tempdir: a loopback TCP port
+            # would be an unauthenticated plaintext door into a TLS-only
+            # service for any local process on a multi-tenant host.
+            import os
+            import secrets
+            import tempfile
 
-        import aiohttp
+            import aiohttp
 
-        from imaginary_tpu.web import accesslog
-        from imaginary_tpu.web.http2 import AlpnDispatcher, H2Protocol
+            from imaginary_tpu.web import accesslog
+            from imaginary_tpu.web.http2 import AlpnDispatcher, H2Protocol
 
-        loopback = web.TCPSite(runner, "127.0.0.1", 0)
-        await loopback.start()
-        lb_port = loopback._server.sockets[0].getsockname()[1]
-        h2_client = aiohttp.ClientSession(
-            auto_decompress=False,  # bytes pass through verbatim
-            connector=aiohttp.TCPConnector(limit=0),
-        )
-        # per-process token: the access log trusts X-Forwarded-* only from
-        # requests that prove they came through OUR terminator hop
-        hop_token = secrets.token_hex(16)
-        accesslog.set_trusted_hop_token(hop_token)
-        h2_conns: set = set()
-        loop_ = asyncio.get_running_loop()
-        h2_server = await loop_.create_server(
-            lambda: AlpnDispatcher(
-                runner.server,
-                lambda: H2Protocol(lb_port, h2_client, hop_token=hop_token,
-                                   conns=h2_conns),
-            ),
-            o.address or None,
-            o.port,
-            ssl=ssl_ctx,
-        )
-    else:
-        site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx)
-        await site.start()
+            # AF_UNIX sun_path caps at ~104-108 bytes; a long TMPDIR (CI
+            # sandboxes, per-user macOS temp dirs) would fail the bind,
+            # so fall back to /tmp when the default tempdir is too deep
+            base = tempfile.gettempdir()
+            if len(os.path.join(base, "imaginary-h2-XXXXXXXX", "hop.sock")) > 100:
+                base = "/tmp"
+            hop_dir = tempfile.mkdtemp(prefix="imaginary-h2-", dir=base)
+            hop_sock = os.path.join(hop_dir, "hop.sock")
+            loopback = web.UnixSite(runner, hop_sock)
+            await loopback.start()
+            h2_client = aiohttp.ClientSession(
+                auto_decompress=False,  # bytes pass through verbatim
+                connector=aiohttp.UnixConnector(path=hop_sock, limit=0),
+            )
+            # per-process token: the access log trusts X-Forwarded-* only from
+            # requests that prove they came through OUR terminator hop
+            hop_token = secrets.token_hex(16)
+            accesslog.set_trusted_hop_token(hop_token)
+            h2_conns: set = set()
+            loop_ = asyncio.get_running_loop()
+            h2_server = await loop_.create_server(
+                lambda: AlpnDispatcher(
+                    runner.server,
+                    lambda: H2Protocol(h2_client, hop_token=hop_token,
+                                       conns=h2_conns),
+                ),
+                o.address or None,
+                o.port,
+                ssl=ssl_ctx,
+            )
+        else:
+            site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx)
+            await site.start()
 
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
 
-    async def memory_release():
-        # role of the reference's FreeOSMemory ticker (imaginary.go:339-347)
-        while not stop.is_set():
-            await asyncio.sleep(max(mrelease, 1))
-            gc.collect()
+        async def memory_release():
+            # role of the reference's FreeOSMemory ticker (imaginary.go:339-347)
+            while not stop.is_set():
+                await asyncio.sleep(max(mrelease, 1))
+                gc.collect()
 
-    ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
-    scheme = "https" if o.cert_file and o.key_file else "http"
-    proto = " (h2+http/1.1)" if h2_server is not None else ""
-    print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}{proto}")
-    await stop.wait()
-    print("shutting down server")
-    if ticker:
-        ticker.cancel()
-    if h2_server is not None:
-        # stop accepting, then give in-flight h2 streams the same 5 s
-        # drain h1 gets from runner.cleanup — closing h2_client while a
-        # stream's loopback hop is mid-flight would 502 a request the h1
-        # path would have completed
-        h2_server.close()
-        await h2_server.wait_closed()
-        deadline = asyncio.get_running_loop().time() + 5.0
-        while (
-            any(p.has_inflight() for p in h2_conns)
-            and asyncio.get_running_loop().time() < deadline
-        ):
-            await asyncio.sleep(0.05)
-    if h2_client is not None:
-        await h2_client.close()
-    await asyncio.wait_for(runner.cleanup(), timeout=5)
+        ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
+        scheme = "https" if o.cert_file and o.key_file else "http"
+        proto = " (h2+http/1.1)" if h2_server is not None else ""
+        print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}{proto}")
+        await stop.wait()
+        print("shutting down server")
+        if ticker:
+            ticker.cancel()
+        if h2_server is not None:
+            # stop accepting, then give in-flight h2 streams the same 5 s
+            # drain h1 gets from runner.cleanup — closing h2_client while a
+            # stream's loopback hop is mid-flight would 502 a request the h1
+            # path would have completed
+            h2_server.close()
+            await h2_server.wait_closed()
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while (
+                any(p.has_inflight() for p in h2_conns)
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+        if h2_client is not None:
+            await h2_client.close()
+        await asyncio.wait_for(runner.cleanup(), timeout=5)
+    finally:
+        # unconditional: a failed boot (port taken, bind error) or a
+        # cleanup timeout must not leak the hop dir
+        if hop_dir is not None:
+            import shutil
+
+            shutil.rmtree(hop_dir, ignore_errors=True)

@@ -8,11 +8,13 @@ asyncio protocol.
 
 Architecture: the nginx-upstream pattern. The public TLS port negotiates
 ALPN; `h2` connections land on `H2Protocol`, which decodes streams with
-nghttp2 and forwards each request over a loopback HTTP/1.1 hop to the
-same process's internal listener — middleware, handlers, and access log
-all run exactly once, identically for both protocols, so there is no
-behavioral drift between h1 and h2 serving. `http/1.1` connections are
-handed to aiohttp's own protocol untouched (AlpnDispatcher).
+nghttp2 and forwards each request over an internal HTTP/1.1 hop to the
+same process's listener on a mode-0700 Unix domain socket — middleware,
+handlers, and access log all run exactly once, identically for both
+protocols, so there is no behavioral drift between h1 and h2 serving,
+and the plaintext hop is reachable only by this uid (never a TCP port
+another tenant could hit). `http/1.1` connections are handed to
+aiohttp's own protocol untouched (AlpnDispatcher).
 
 Request and response bodies are fully buffered per stream; the service's
 own 64 MB body cap (source_body.go:13) bounds memory, and image payloads
@@ -212,9 +214,8 @@ class H2Protocol(asyncio.Protocol):
     MAX_STREAMS = 32
     MAX_CONN_BUFFER = 2 << 26  # 128 MB of request bodies per connection
 
-    def __init__(self, forward_port: int, client: "object", max_body: int = 1 << 26,
+    def __init__(self, client: "object", max_body: int = 1 << 26,
                  hop_token: str = "", conns: Optional[set] = None):
-        self._forward_port = forward_port
         self._client = client  # shared aiohttp.ClientSession
         self._max_body = max_body
         self._hop_token = hop_token
@@ -411,7 +412,9 @@ class H2Protocol(asyncio.Protocol):
                 headers.append(("X-Internal-Hop", self._hop_token))
             from multidict import CIMultiDict
 
-            url = f"http://127.0.0.1:{self._forward_port}{path}"
+            # the client's UnixConnector ignores the URL authority; "h2-hop"
+            # only labels the hop in tracebacks (real Host rides the header)
+            url = f"http://h2-hop{path}"
             async with self._client.request(
                 method, url, headers=CIMultiDict(headers),
                 data=bytes(st.body) if st.body else None,

@@ -442,7 +442,7 @@ class TestRotateAngleFlooring:
         (135, (740, 550)),   # floors to 90
         (225, (550, 740)),   # floors to 180
         (275, (740, 550)),   # floors to 270
-        (450, (550, 740)),   # >=360: unverifiable vs bimg -> conservative no-op
+        (450, (740, 550)),   # >=360: getAngle clamps min(angle, 270) -> 270
     ])
     def test_floors_like_bimg(self, angle, expect_wh):
         o = ImageOptions(rotate=angle)

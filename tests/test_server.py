@@ -633,7 +633,8 @@ class TestBootLivenessGate:
         # cpu, so clear it
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         monkeypatch.delenv("IMAGINARY_TPU_PLATFORM", raising=False)
-        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_start_device_probe",
+                            lambda **kw: object())
         monkeypatch.setattr(cli, "_finish_device_probe",
                             lambda p, timeout=75.0: (False, "link down"))
         assert cli.main(["--require-device", "--port", "0"]) == 2
@@ -646,7 +647,8 @@ class TestBootLivenessGate:
 
         monkeypatch.delenv("JAX_PLATFORMS", raising=False)
         monkeypatch.delenv("IMAGINARY_TPU_PLATFORM", raising=False)
-        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_start_device_probe",
+                            lambda **kw: object())
         monkeypatch.setattr(cli, "_finish_device_probe",
                             lambda p, timeout=75.0: (False, "link down"))
 
@@ -679,7 +681,43 @@ class TestBootLivenessGate:
         from imaginary_tpu import cli
 
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-        monkeypatch.setattr(cli, "_start_device_probe", lambda: object())
+        monkeypatch.setattr(cli, "_start_device_probe",
+                            lambda **kw: object())
         monkeypatch.setattr(cli, "_finish_device_probe",
                             lambda p, timeout=75.0: (False, "pinned but dead"))
         assert cli.main(["--require-device", "--port", "0"]) == 2
+
+    def test_require_device_rejects_clean_cpu_fallback(self):
+        """jax silently degrades to the CPU backend when the accelerator
+        plugin is absent or fails without hanging; with --require-device
+        the probe must treat that as DEAD, not alive (a liveness-only
+        probe would exit 0 and boot the server on CPU). On this CPU-only
+        host the child's non-CPU assert fires, proving the refusal."""
+        from imaginary_tpu import cli
+
+        alive, diag = cli._finish_device_probe(
+            cli._start_device_probe(platform="cpu", require_accel=True))
+        assert alive is False
+        assert "CPU backend" in diag
+
+    def test_probe_forwards_platform_pin_to_child(self, monkeypatch):
+        """The probe must run the SAME backend the server will: the pin
+        is re-applied via jax.config inside the child (env JAX_PLATFORMS
+        is NOT enough — the tunnel plugin overrides it at boot)."""
+        from imaginary_tpu import cli
+
+        captured = {}
+        import subprocess as sp
+
+        real_popen = sp.Popen
+
+        def spy(cmd, **kw):
+            captured["code"] = cmd[-1]
+            return real_popen([cmd[0], "-c", "pass"], stdout=sp.DEVNULL,
+                              stderr=sp.PIPE)
+
+        monkeypatch.setattr(sp, "Popen", spy)
+        proc = cli._start_device_probe(platform="cpu", require_accel=False)
+        cli._finish_device_probe(proc)
+        assert "jax.config.update('jax_platforms', 'cpu')" in captured["code"]
+        assert "assert" not in captured["code"]  # accel check only when asked
