@@ -75,7 +75,7 @@ class EncodeOptions:
     compression: int = 0  # PNG zlib level, 0 -> default 6
     interlace: bool = False  # progressive JPEG / interlaced PNG
     palette: bool = False  # PNG8
-    speed: int = 0  # reserved (AVIF effort in the reference)
+    speed: int = 0  # encoder effort: HEIF/AVIF speed, PNG filter strategy
     strip_metadata: bool = False
 
     def effective_quality(self) -> int:
@@ -408,7 +408,8 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
 
         if vb.heif_encode_available("hevc"):
             try:
-                return vb.encode_heif(arr, opts.effective_quality(), "hevc")
+                return vb.encode_heif(arr, opts.effective_quality(), "hevc",
+                                      speed=opts.speed)
             except Exception as e:
                 raise CodecError(f"Cannot encode image: {e}", 400) from None
         raise CodecError("HEIF encoding requires a libheif HEVC encoder", 400)
@@ -423,7 +424,8 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
 
             if vb.heif_encode_available("av1"):
                 try:
-                    return vb.encode_heif(arr, opts.effective_quality(), "av1")
+                    return vb.encode_heif(arr, opts.effective_quality(), "av1",
+                                          speed=opts.speed)
                 except Exception as e:
                     raise CodecError(f"Cannot encode image: {e}", 400) from None
             raise

@@ -1,10 +1,11 @@
 """Native C++ codec backend shim.
 
 Wraps the `_imaginary_codecs` extension (imaginary_tpu/native/codecs.cpp —
-libjpeg/libpng/libwebp with the GIL released) when built via
-`python -m imaginary_tpu.native.build`. Formats the extension doesn't cover
-(GIF/TIFF, palette/interlace output) delegate to the PIL backend; probing
-delegates for metadata richness (ICC/space) with native fallback.
+libjpeg/libpng/libwebp/libtiff plus an in-tree GIF codec and palette
+quantizer, all with the GIL released). Every DECODABLE/ENCODABLE raster
+format runs natively (SURVEY.md section 2.12: no Python stand-ins on the
+pixel path); PIL appears only in probe(), where its header-only open
+carries richer /info metadata (ICC/space) than the C parsers report.
 """
 
 from __future__ import annotations
@@ -23,24 +24,19 @@ except ImportError:  # pragma: no cover - extension not built
 
 
 def available() -> bool:
-    return _ext is not None
+    return _ext is not None and getattr(_ext, "ABI", 0) >= 3
 
 
-_NATIVE_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP}
+_NATIVE_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP,
+                 ImageType.GIF, ImageType.TIFF}
 
 
 def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
     if t not in _NATIVE_TYPES:
-        from imaginary_tpu.codecs import pil_backend
-
-        return pil_backend.decode(buf, t, shrink)
+        raise CodecError(f"Cannot decode image: unsupported format {t.value}", 400)
     denom = shrink if (t is ImageType.JPEG and shrink in (2, 4, 8)) else 1
     try:
-        try:
-            pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value, denom)
-        except TypeError:
-            # older extension build without the scale argument
-            pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value)
+        pixels, h, w, c, orientation, has_alpha = _ext.decode(buf, t.value, denom)
     except Exception as e:
         raise CodecError(f"Cannot decode image: {e}", 400) from None
     # the extension always emits 3- or 4-channel RGB(A)
@@ -50,12 +46,8 @@ def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
 
 def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
     t = opts.type
-    # palette output needs PIL's quantizer; interlace maps to progressive
-    # JPEG natively (interlaced-PNG writing exists in no available backend)
-    if t not in _NATIVE_TYPES or opts.palette:
-        from imaginary_tpu.codecs import pil_backend
-
-        return pil_backend.encode(arr, opts)
+    if t not in _NATIVE_TYPES:
+        raise CodecError(f"Cannot encode image: unsupported format {t.value}", 400)
     arr = np.ascontiguousarray(arr)
     h, w, c = arr.shape
     try:
@@ -64,6 +56,7 @@ def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
             arr, h, w, c, t.value,
             opts.effective_quality(), opts.effective_compression(),
             1 if opts.interlace else 0,
+            1 if opts.palette else 0, max(0, opts.speed),
         )
     except Exception as e:
         raise CodecError(f"Cannot encode image: {e}", 400) from None

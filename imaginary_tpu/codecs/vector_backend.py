@@ -393,6 +393,14 @@ def _setup_heif_encode():
     ]
     h.heif_encoder_set_lossy_quality.restype = _HeifError
     h.heif_encoder_set_lossy_quality.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    h.heif_encoder_set_parameter_integer.restype = _HeifError
+    h.heif_encoder_set_parameter_integer.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    ]
+    h.heif_encoder_set_parameter_string.restype = _HeifError
+    h.heif_encoder_set_parameter_string.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+    ]
     h.heif_image_create.restype = _HeifError
     h.heif_image_create.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -449,8 +457,15 @@ def heif_encode_available(fmt: str = "hevc") -> bool:
     return ok
 
 
-def encode_heif(arr: np.ndarray, quality: int = 80, fmt: str = "hevc") -> bytes:
+def encode_heif(arr: np.ndarray, quality: int = 80, fmt: str = "hevc",
+                speed: int = 0) -> bytes:
     """HWC uint8 (C in 1/3/4) -> HEIF (hevc) or AVIF (av1) bytes.
+
+    speed is the reference's Speed param (options.go:47 -> bimg -> vips
+    heifsave effort): 0 leaves the encoder default; higher trades size/
+    quality for encode time. AV1 (aom) takes an integer "speed" 0-9;
+    HEVC (x265) maps to a "preset" name. Unsupported parameters are
+    ignored — a foreign encoder plugin must not fail the request.
 
     Writes through a temp file: libheif's streaming writer callback
     returns a struct by value, which ctypes callbacks cannot express
@@ -477,6 +492,16 @@ def encode_heif(arr: np.ndarray, quality: int = 80, fmt: str = "hevc") -> bytes:
         if e.code != 0:
             raise ValueError(f"libheif: no {fmt} encoder")
         h.heif_encoder_set_lossy_quality(enc, max(1, min(int(quality), 100)))
+        if speed > 0:
+            s = min(int(speed), 9)
+            if fmt == "av1":
+                h.heif_encoder_set_parameter_integer(enc, b"speed", s)
+            else:  # x265 understands presets, not a numeric speed; x265's
+                # default is "medium", so the ladder starts there to keep
+                # speed monotonic (speed=1 must never be SLOWER than 0)
+                presets = [b"medium", b"fast", b"fast", b"faster", b"veryfast",
+                           b"veryfast", b"superfast", b"superfast", b"ultrafast"]
+                h.heif_encoder_set_parameter_string(enc, b"preset", presets[s - 1])
         e = h.heif_image_create(w, ht, _HEIF_COLORSPACE_RGB, chroma, ctypes.byref(img))
         if e.code != 0:
             raise ValueError("libheif: image_create failed")

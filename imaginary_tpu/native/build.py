@@ -25,7 +25,7 @@ def build(verbose: bool = True) -> str:
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
         f"-I{include}",
         src, "-o", out,
-        "-ljpeg", "-lpng", "-lwebp",
+        "-ljpeg", "-lpng", "-lwebp", "-ltiff",
     ]
     if verbose:
         print(" ".join(cmd))

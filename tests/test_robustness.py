@@ -35,7 +35,7 @@ def _cuts(buf: bytes):
     return head + body
 
 
-@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp"])
+@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp", "gif", "tiff"])
 def test_truncations_never_crash_decode(fmt):
     buf = _mk(fmt)
     ok = 0
@@ -50,7 +50,7 @@ def test_truncations_never_crash_decode(fmt):
     assert codecs.decode(buf, 1).array.shape[:2] == (64, 96)
 
 
-@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp"])
+@pytest.mark.parametrize("fmt", ["jpeg", "png", "webp", "gif", "tiff"])
 def test_bitflips_never_crash_decode(fmt):
     buf = bytearray(_mk(fmt))
     rng = np.random.default_rng(11)
@@ -65,7 +65,7 @@ def test_bitflips_never_crash_decode(fmt):
 
 
 def test_probe_on_truncations_and_noise():
-    for fmt in ("jpeg", "png", "webp"):
+    for fmt in ("jpeg", "png", "webp", "gif", "tiff"):
         buf = _mk(fmt)
         for cut in _cuts(buf):
             try:
