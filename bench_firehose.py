@@ -14,6 +14,13 @@
     and is labeled so; the measured mechanics (bucketing, jit-cache
     bound, batch formation) are identical either way.
 
+PLUS the 5-format codec firehose (VERDICT r4 next #8): full
+decode->transform->encode round trips across JPEG/PNG/WEBP/GIF/TIFF
+under thread concurrency, with a per-format latency split. The r4 risk
+this measures was PIL-backed GIF/TIFF holding the GIL mid-decode and
+degrading JPEG throughput on the shared pool; r5 moved every format
+into the GIL-released C extension, and the split is the evidence.
+
 One JSON line per config on stdout; detail on stderr.
 """
 
@@ -136,6 +143,70 @@ def bench_firehose(duration: float, n_threads: int) -> dict:
     }
 
 
+def bench_format_firehose(duration: float, n_threads: int) -> dict:
+    """Full e2e round trips (decode -> plan -> execute -> encode SAME
+    format) over a 5-format mixed stream; per-format latency split."""
+    import numpy as np
+
+    from bench_util import pctl, run_workers
+    from imaginary_tpu import codecs
+    from imaginary_tpu.codecs import EncodeOptions
+    from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+    from imaginary_tpu.imgtype import ImageType
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops.plan import plan_operation
+
+    fmts = [ImageType.JPEG, ImageType.PNG, ImageType.WEBP,
+            ImageType.GIF, ImageType.TIFF]
+    raw = _gen_stream(20, seed=31)
+    stream = []
+    for i, (buf, _) in enumerate(raw):
+        import cv2
+
+        arr = cv2.imdecode(np.frombuffer(buf, np.uint8), cv2.IMREAD_COLOR)[..., ::-1]
+        t = fmts[i % len(fmts)]
+        stream.append((codecs.encode(np.ascontiguousarray(arr), EncodeOptions(type=t)), t))
+
+    ex = Executor(ExecutorConfig(window_ms=2.0, host_spill=None))
+    o = ImageOptions(width=300)
+    lats_by_fmt: dict = {t.value: [] for t in fmts}
+    lock = threading.Lock()
+
+    def one_rt(buf, t):
+        d = codecs.decode(buf, 1)
+        plan = plan_operation("resize", o, d.array.shape[0], d.array.shape[1],
+                              0, d.array.shape[2])
+        out = ex.process(d.array, plan)
+        codecs.encode(out, EncodeOptions(type=t))
+
+    for buf, t in stream:  # warm every bucket/chain
+        one_rt(buf, t)
+
+    def one(k, i):
+        buf, t = stream[i % len(stream)]
+        t0 = time.monotonic()
+        one_rt(buf, t)
+        dt = (time.monotonic() - t0) * 1000.0
+        with lock:
+            lats_by_fmt[t.value].append(dt)
+
+    rate, flat = run_workers(one, duration, n_threads)
+    ex.shutdown()
+    split = {
+        f: {"n": len(ls), "p50_ms": pctl(ls, 0.5), "p99_ms": pctl(ls, 0.99)}
+        for f, ls in lats_by_fmt.items() if ls
+    }
+    return {
+        "metric": "codec_firehose_5_formats_e2e",
+        "value": round(rate, 2),
+        "unit": "imgs/sec",
+        "p50_ms": pctl(flat, 0.5),
+        "p99_ms": pctl(flat, 0.99),
+        "per_format": split,
+        "codec_backend": codecs.backend_name(),
+    }
+
+
 def main():
     duration = float(os.environ.get("BENCH_DURATION", "20"))
     n_threads = int(os.environ.get("BENCH_THREADS", "16"))
@@ -160,7 +231,7 @@ def main():
     import jax
 
     backend = backend or jax.default_backend()
-    for fn in (bench_smartcrop, bench_firehose):
+    for fn in (bench_smartcrop, bench_firehose, bench_format_firehose):
         res = fn(duration, n_threads)
         res["backend"] = backend
         print(f"[firehose] {res['metric']}: {res['value']} {res['unit']} "

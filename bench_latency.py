@@ -230,15 +230,34 @@ def _cv2_workloads(buf_1080: bytes, buf_4k) -> dict:
     return out
 
 
-def baseline_latency(fn, per_call: float = 1.0, n: int = 40) -> dict:
-    """cv2 latency distribution of one scenario-equivalent workload."""
+def baseline_latency(fn, per_call: float = 1.0, n: int = 40,
+                     windows: int = 3) -> dict:
+    """cv2 latency distribution of one scenario-equivalent workload,
+    MEDIANED across independent windows.
+
+    A single window's bar swings up to 4x between runs on the shared
+    1-CPU host (measured: pipeline baseline p99 11.9-49.1 ms across four
+    same-day runs) while our own medianed body holds still — so verdicts
+    were flipping on baseline noise, not on our latency. The bar is now
+    medianed exactly the way `ours` is: per-window percentiles, median
+    across windows; the per-window p99s ride along in the JSON so a
+    noisy-host run is visible in the artifact."""
     fn()
-    lats = []
-    for _ in range(n):
-        t0 = time.monotonic()
-        fn()
-        lats.append((time.monotonic() - t0) * 1000.0 / per_call)
-    return {"p50_ms": _pctl(lats, 0.50), "p99_ms": _pctl(lats, 0.99)}
+    per = []
+    for _ in range(max(1, windows)):
+        lats = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            fn()
+            lats.append((time.monotonic() - t0) * 1000.0 / per_call)
+        per.append({"p50_ms": _pctl(lats, 0.50), "p99_ms": _pctl(lats, 0.99)})
+
+    def med(k):
+        vals = sorted(w[k] for w in per)
+        return vals[len(vals) // 2]
+
+    return {"p50_ms": med("p50_ms"), "p99_ms": med("p99_ms"),
+            "window_p99s": [w["p99_ms"] for w in per]}
 
 
 async def main_async():
@@ -339,11 +358,32 @@ async def main_async():
     workloads = _cv2_workloads(buf, buf4k)
     if keep is not None:  # BENCH_ONLY: don't burn ~41 cv2 iterations per
         workloads = {n: w for n, w in workloads.items() if n in keep}  # unmeasured route
+    # BENCH_BASELINE_PIN=<path>: persist the medianed bars per host so
+    # repeat runs grade against ONE recorded baseline — a verdict flip
+    # then requires OUR body to move, not the shared host's noise.
+    pin = os.environ.get("BENCH_BASELINE_PIN", "")
     baselines = {}
-    for name, (fn, per_call) in workloads.items():
+    if pin and os.path.exists(pin):
+        with open(pin) as f:
+            baselines = {k: v for k, v in json.load(f).items() if k in workloads}
+        print(f"[lat] cv2 baselines PINNED from {pin}: "
+              f"{sorted(baselines)}", file=sys.stderr)
+    missing = [n for n in workloads if n not in baselines]
+    for name in missing:
+        fn, per_call = workloads[name]
         baselines[name] = baseline_latency(fn, per_call)
         print(f"[lat] cv2 baseline[{name}]: p50={baselines[name]['p50_ms']}ms "
-              f"p99={baselines[name]['p99_ms']}ms", file=sys.stderr)
+              f"p99={baselines[name]['p99_ms']}ms "
+              f"(windows: {baselines[name]['window_p99s']})", file=sys.stderr)
+    if pin and missing:
+        merged = {}
+        if os.path.exists(pin):
+            with open(pin) as f:
+                merged = json.load(f)
+        merged.update({n: baselines[n] for n in missing})
+        with open(pin, "w") as f:
+            json.dump(merged, f, indent=1)
+        print(f"[lat] wrote measured baselines to {pin}", file=sys.stderr)
 
     results = []
     for name, pathq, method, body, inp in scenarios:
@@ -365,6 +405,8 @@ async def main_async():
         base = baselines.get(name)
         if base:
             res["baseline_p99_ms"] = base["p99_ms"]
+            if base.get("window_p99s"):
+                res["baseline_window_p99s"] = base["window_p99s"]
             res["p99_vs_2x_baseline"] = (
                 "PASS" if res["p99_ms"] <= 2 * base["p99_ms"] else "FAIL"
             )

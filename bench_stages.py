@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Host-ceiling decomposition: per-stage ms, us vs the cv2 baseline.
+
+BASELINE.md's "~3.6x ceiling on the 1-CPU bench host" claim needs the
+decomposition on record, not asserted (VERDICT r4 weak #2): the bench
+request is probe -> decode -> transform (device or host spill) -> encode,
+and only the TRANSFORM stage can ride the chip — decode/encode are host
+C work both for us and for cv2/libvips. This harness times each stage
+serially (median of N), prints one JSON line, and derives the ceiling:
+
+    ceiling = T_baseline_total / (T_our_host_fixed + T_transform_min)
+
+where T_our_host_fixed = probe + decode + encode (host-bound no matter
+what the accelerator does) and T_transform_min is the transform's floor
+(0 for the ideal-chip bound; the measured device or spill time for the
+actual configuration).
+
+Usage: python bench_stages.py            # honest backend autodetect
+       BENCH_PLATFORM=cpu python bench_stages.py
+Artifact: artifacts/host_ceiling_<backend>.json (+ stdout JSON line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from bench_util import make_1080p_jpeg, pctl, probe_accelerator
+
+
+def _median_ms(fn, n: int = 60) -> float:
+    fn()  # warm
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    return pctl(ts, 0.50)
+
+
+def main() -> None:
+    platform = os.environ.get("BENCH_PLATFORM", "")
+    fallback = False
+    if not platform and not probe_accelerator():
+        print("[stages] *** ACCELERATOR UNREACHABLE - CPU-JAX FALLBACK ***",
+              file=sys.stderr)
+        platform = "cpu"
+        fallback = True
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    import cv2
+    import jax
+
+    from imaginary_tpu import codecs
+    from imaginary_tpu.codecs import EncodeOptions
+    from imaginary_tpu.engine import Executor, ExecutorConfig
+    from imaginary_tpu.imgtype import ImageType
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops.plan import choose_decode_shrink, plan_operation
+
+    buf = make_1080p_jpeg()
+    opts = ImageOptions(width=300, height=200)
+
+    # ---- our stages (the exact hot-path sequence bench.py runs) ----------
+    meta = codecs.probe_fast(buf)
+    shrink = choose_decode_shrink("resize", opts, meta.height, meta.width,
+                                  meta.orientation, 3)
+    d = codecs.decode(buf, shrink)
+    plan = plan_operation("resize", opts, d.array.shape[0], d.array.shape[1],
+                          d.orientation, d.array.shape[2])
+
+    ours = {
+        "probe_ms": _median_ms(lambda: codecs.probe_fast(buf)),
+        "decode_ms": _median_ms(lambda: codecs.decode(buf, shrink)),
+    }
+    # transform, device-primary (batch=1 serial — the decomposition view;
+    # throughput amortizes this over micro-batches)
+    ex_dev = Executor(ExecutorConfig(window_ms=0.0, max_batch=16, host_spill=False))
+    out_arr = ex_dev.process(d.array, plan)
+    ours["transform_device_ms"] = _median_ms(lambda: ex_dev.process(d.array, plan))
+    ex_dev.shutdown()
+    # transform, host-spill interpreter (what serves when the link is slow)
+    from imaginary_tpu.engine import host_exec
+
+    ours["transform_host_ms"] = _median_ms(lambda: host_exec.run(d.array, plan))
+    ours["encode_ms"] = _median_ms(
+        lambda: codecs.encode(out_arr, EncodeOptions(type=ImageType.JPEG)))
+    ours["host_fixed_ms"] = round(
+        ours["probe_ms"] + ours["decode_ms"] + ours["encode_ms"], 3)
+
+    # ---- cv2 baseline stages (same work split) ---------------------------
+    data = np.frombuffer(buf, np.uint8)
+    a = cv2.imdecode(data, cv2.IMREAD_COLOR)
+    r = cv2.resize(a, (300, 200), interpolation=cv2.INTER_AREA)
+    jq = [int(cv2.IMWRITE_JPEG_QUALITY), 80]
+    base = {
+        "decode_ms": _median_ms(lambda: cv2.imdecode(data, cv2.IMREAD_COLOR)),
+        "transform_ms": _median_ms(
+            lambda: cv2.resize(a, (300, 200), interpolation=cv2.INTER_AREA)),
+        "encode_ms": _median_ms(lambda: cv2.imencode(".jpg", r, jq)),
+    }
+    base["total_ms"] = round(sum(base.values()), 3)
+
+    # ---- ceiling math ----------------------------------------------------
+    # On a 1-CPU host, serial rates bound single-process throughput. The
+    # ideal-chip ceiling zeroes the transform; the spill ceiling uses the
+    # host interpreter's transform (what the cost model actually serves
+    # over a saturated link).
+    ceil_ideal = base["total_ms"] / ours["host_fixed_ms"] if ours["host_fixed_ms"] else 0.0
+    ceil_spill = base["total_ms"] / (ours["host_fixed_ms"] + ours["transform_host_ms"])
+
+    backend = "cpu-fallback" if fallback else jax.default_backend()
+    result = {
+        "metric": "host_ceiling_decomposition_resize_1080p",
+        "backend": backend,
+        "ours": ours,
+        "cv2_baseline": base,
+        "ceiling_ideal_chip_x": round(ceil_ideal, 2),
+        "ceiling_host_spill_x": round(ceil_spill, 2),
+        "note": ("ceiling_ideal_chip_x = cv2_total / our host-fixed work "
+                 "(probe+decode+encode): the single-process per-request "
+                 "speedup bound on THIS host even with an infinitely fast "
+                 "accelerator; decode/encode parallelism across workers/"
+                 "cores is what raises it"),
+    }
+    os.makedirs("artifacts", exist_ok=True)
+    path = os.path.join("artifacts", f"host_ceiling_{backend}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"[stages] wrote {path}", file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

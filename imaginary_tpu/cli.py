@@ -119,6 +119,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-endpoints", default="", help="CSV of endpoints to disable")
     p.add_argument("--version", action="store_true")
     # TPU engine flags (no reference counterpart)
+    p.add_argument("--workers", type=int, default=1,
+                   help="serving processes on one port via SO_REUSEPORT "
+                        "(0 = one per CPU core); worker 0 owns the device, "
+                        "the rest serve on the host backend")
     p.add_argument("--batch-window-ms", type=float, default=3.0, help="micro-batch window")
     p.add_argument("--max-batch", type=int, default=16, help="micro-batch size cap")
     p.add_argument("--use-mesh", action="store_true", help="shard batches over the device mesh")
@@ -142,6 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=-1,
                    help="this process's index (auto-discovered on TPU pods)")
     return p
+
+
+def _resolve_workers(n: int) -> int:
+    if n == 0:  # auto: one per core
+        return max(1, os.cpu_count() or 1)
+    return max(1, n)
 
 
 def options_from_args(args) -> ServerOptions:
@@ -203,6 +213,7 @@ def options_from_args(args) -> ServerOptions:
         return_size=args.return_size,
         cpus=args.cpus,
         endpoints=parse_endpoints(args.disable_endpoints),
+        workers=_resolve_workers(args.workers),
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,
@@ -227,6 +238,20 @@ def main(argv=None) -> int:
 
     if args.gzip:  # ref: imaginary.go:168-171
         print("warning: -gzip flag is deprecated and will not have effect")
+
+    # Multi-process serving: the parent becomes the supervisor and the
+    # workers re-enter main() marked by WORKER_ENV (web/workers.py holds
+    # the design: SO_REUSEPORT fan-in, worker 0 owns the device).
+    from imaginary_tpu.web.workers import WORKER_ENV, run_supervisor, worker_index
+
+    if o.workers > 1 and WORKER_ENV not in os.environ:
+        return run_supervisor(list(argv) if argv is not None else sys.argv[1:],
+                              o.workers)
+    if worker_index() > 0:
+        # non-owner workers are CPU-pinned BY DESIGN (the chip accepts one
+        # client); --require-device is worker 0's guarantee — enforcing it
+        # here would deterministically crash-loop the rest of the fleet
+        args.require_device = False
 
     # Pin the JAX platform when asked (e.g. IMAGINARY_TPU_PLATFORM=cpu for
     # dev boxes where the TPU plugin force-registers itself at boot and

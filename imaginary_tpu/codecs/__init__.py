@@ -368,9 +368,21 @@ def _decode_special(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
         if t is ImageType.SVG and vb.svg_available():
             arr = vb.rasterize_svg(buf, shrink=shrink)
             return DecodedImage(array=arr, type=t, orientation=0, has_alpha=True)
-        if t is ImageType.PDF and vb.pdf_available():
-            arr = vb.rasterize_pdf(buf)
-            return DecodedImage(array=arr, type=t, orientation=0, has_alpha=False)
+        if t is ImageType.PDF:
+            if vb.pdf_available():
+                arr = vb.rasterize_pdf(buf)
+                return DecodedImage(array=arr, type=t, orientation=0, has_alpha=False)
+            # vendored fallback renderer (codecs/pdf_mini.py): classic-xref
+            # vector subset at poppler geometry; documents beyond the
+            # subset fall through to the 406 gate exactly like a
+            # poppler-less libvips build
+            from imaginary_tpu.codecs import pdf_mini
+
+            try:
+                arr = pdf_mini.rasterize(buf)
+                return DecodedImage(array=arr, type=t, orientation=0, has_alpha=False)
+            except pdf_mini.UnsupportedPdf:
+                pass
         if t is ImageType.AVIF:
             try:  # PIL's avif plugin when compiled in, else libheif
                 arr, has_alpha = _pil_open_rgba(buf)

@@ -130,6 +130,10 @@ def probe(buf: bytes, t: ImageType) -> ImageMetadata:
         raise CodecError(f"Cannot decode image: {e}", 400) from None
     has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)
     channels = len(im.getbands())
+    if im.mode == "P":
+        # a palette image DECODES to RGB(A); report the decoded channel
+        # count the way vips' metadata does, not the index plane's 1
+        channels = 4 if has_alpha else 3
     return ImageMetadata(
         width=im.width,
         height=im.height,

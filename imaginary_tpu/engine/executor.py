@@ -282,7 +282,11 @@ class Executor:
 
             from imaginary_tpu.parallel import batch_sharding, get_mesh
 
-            mesh = get_mesh(self.config.n_devices, self.config.spatial)
+            # local=True: in a multi-process fleet the executor serves on
+            # THIS process's chips (see get_mesh's docstring); identical
+            # to the global mesh in a single process
+            mesh = get_mesh(self.config.n_devices, self.config.spatial,
+                            local=True)
             self._sharding = batch_sharding(mesh)
             self._mesh_batch = mesh.devices.shape[0]
             self._mesh_spatial = mesh.devices.shape[1]

@@ -55,9 +55,20 @@ def init_distributed(
 
 
 @functools.lru_cache(maxsize=None)
-def get_mesh(n_devices: Optional[int] = None, spatial: int = 1) -> Mesh:
-    """Build a (batch, spatial) mesh over the first n_devices devices."""
-    devs = jax.devices()
+def get_mesh(n_devices: Optional[int] = None, spatial: int = 1,
+             local: bool = False) -> Mesh:
+    """Build a (batch, spatial) mesh over the first n_devices devices.
+
+    local=True restricts to THIS process's addressable devices — the
+    serving executor's mesh in a multi-process fleet. Request batches are
+    process-local host data, and multi-controller jit requires every
+    process to execute the same program in lockstep; independent async
+    micro-batches can't do that, and device_put onto non-addressable
+    devices refuses outright. So serving shards over local chips while
+    the GLOBAL mesh carries the collective paths (psum/spatial work,
+    where all processes do run in lockstep). In a single process the two
+    meshes are identical."""
+    devs = jax.local_devices() if local else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)

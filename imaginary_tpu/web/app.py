@@ -90,6 +90,19 @@ async def _image(service, name, request):
     return await service.handle(request, name)
 
 
+def _pin_groups(ctx) -> bool:
+    """Pin the reference's curve preferences (X25519, P-256, P-384 —
+    server.go:116-120). Python grew set_groups in 3.13; before that the
+    only knob is set_ecdh_curve, which takes ONE EC curve and would DROP
+    X25519 — so on older interpreters the default group order (which
+    already leads with X25519) is left in place rather than pinned wrong.
+    Returns whether the pin was applied."""
+    if hasattr(ctx, "set_groups"):  # Python >= 3.13
+        ctx.set_groups("x25519:prime256v1:secp384r1")
+        return True
+    return False
+
+
 def make_ssl_context(o: ServerOptions) -> Optional[ssl.SSLContext]:
     if not (o.cert_file and o.key_file):
         return None
@@ -104,11 +117,7 @@ def make_ssl_context(o: ServerOptions) -> Optional[ssl.SSLContext]:
         "ECDHE-ECDSA-AES128-GCM-SHA256:ECDHE-RSA-AES128-GCM-SHA256:"
         "ECDHE-ECDSA-CHACHA20-POLY1305:ECDHE-RSA-CHACHA20-POLY1305"
     )
-    # The reference also pins curve preferences (X25519, P-256, P-384).
-    # Python's ssl module cannot express a key-share group preference list
-    # before 3.13 (set_ecdh_curve takes a single EC curve and would DROP
-    # X25519); OpenSSL's default group order already leads with X25519, so
-    # the default is left in place rather than pinned wrong.
+    _pin_groups(ctx)
     # ALPN: h2 + http/1.1, like the reference (Go's net/http advertises h2
     # natively — server.go:114). Our h2 terminator rides libnghttp2 via
     # ctypes (web/http2.py); when that library is absent, or --disable-http2
@@ -189,9 +198,11 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
                 o.address or None,
                 o.port,
                 ssl=ssl_ctx,
+                reuse_port=o.workers > 1 or None,
             )
         else:
-            site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx)
+            site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx,
+                               reuse_port=o.workers > 1 or None)
             await site.start()
 
         stop = asyncio.Event()

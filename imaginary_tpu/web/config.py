@@ -49,6 +49,9 @@ class ServerOptions:
     log_level: str = "info"
     return_size: bool = False
     cpus: int = 0  # host worker-thread cap, 0 = auto (role of -cpus/GOMAXPROCS)
+    # serving processes sharing the port via SO_REUSEPORT (web/workers.py);
+    # >1 makes every listener bind with reuse_port
+    workers: int = 1
     # --- TPU engine knobs (no reference counterpart) -------------------------
     batch_window_ms: float = 3.0
     # default mirrors engine.executor.MAX_BATCH (kept literal here so this

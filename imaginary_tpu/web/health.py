@@ -36,7 +36,14 @@ def get_health_stats(executor=None) -> dict:
         "threads": threading.active_count(),
         "cpus": os.cpu_count() or 1,
         "gcCollections": sum(s["collections"] for s in gc.get_stats()),
+        # which serving process answered: under --workers N each worker
+        # has its own executor/caches, so an operator debugging a skewed
+        # fleet needs to attribute /health samples to processes
+        "pid": os.getpid(),
     }
+    from imaginary_tpu.web.workers import worker_index
+
+    stats["worker"] = worker_index()
     try:
         import jax
 

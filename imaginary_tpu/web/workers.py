@@ -1,0 +1,144 @@
+"""Multi-process serving: the --workers N supervisor.
+
+The reference gets multi-core scaling for free from Go's per-request
+goroutines (ref: server.go:110-166) and its docs scale further with N
+identical stateless instances behind a balancer (README.md:248-269). Our
+Python process is GIL-bound for everything outside the GIL-released C
+codec layer, so the equivalent is N worker PROCESSES accepting on one
+port via SO_REUSEPORT: the kernel load-balances connections, there is no
+proxy hop, and a worker crash loses only its own in-flight requests.
+
+Chip ownership: a TPU chip accepts ONE client process, so worker 0 keeps
+the configured backend (the device owner) and workers 1..N-1 are pinned
+to the CPU backend (IMAGINARY_TPU_PLATFORM=cpu), serving through the
+same host SIMD path the cost model already spills to under link
+saturation. On a multi-chip host, give each worker its own chip instead
+by exporting TPU_VISIBLE_DEVICES per worker (documented, not automated:
+chip topology is a deployment concern).
+
+The supervisor is the parent process: it spawns workers as fresh
+interpreters (never fork-after-jax-init — the runtime owns threads a
+fork would orphan), forwards SIGTERM/SIGINT so every worker runs its own
+graceful 5 s drain, and respawns a worker that dies unexpectedly, with a
+restart budget so a boot-crash loop terminates instead of spinning.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# env contract with cli.main: presence of WORKER_ENV marks a child (it
+# must serve, never supervise) and carries its index; reuse_port comes
+# from the child's own re-parsed --workers flag.
+WORKER_ENV = "IMAGINARY_TPU_WORKER"
+
+# A worker that dies gets this many respawns per rolling hour before the
+# supervisor gives up and shuts the fleet down (a crash loop at boot
+# would otherwise spin forever at one jax-import per iteration).
+MAX_RESTARTS_PER_WORKER = 5
+
+
+def worker_index() -> int:
+    """This process's worker index; 0 when not running under a supervisor
+    (a single-process server IS worker 0, the device owner)."""
+    try:
+        return int(os.environ.get(WORKER_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def _spawn(argv: list, idx: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env[WORKER_ENV] = str(idx)
+    if idx > 0:
+        # non-owner workers must not race worker 0 for the chip; an
+        # operator-set platform pin (or per-worker TPU_VISIBLE_DEVICES)
+        # wins over this default
+        env.setdefault("IMAGINARY_TPU_PLATFORM", "cpu")
+    return subprocess.Popen([sys.executable, "-m", "imaginary_tpu.cli"] + argv,
+                            env=env)
+
+
+def run_supervisor(argv: list, workers: int) -> int:
+    """Spawn and babysit `workers` serving processes; returns an exit code.
+
+    Lifecycle: SIGTERM/SIGINT here fans out to every worker (each drains
+    in-flight requests, ref: server.go:144-165 semantics per process);
+    the supervisor then waits for all of them. An unexpected worker death
+    outside shutdown is respawned under the restart budget.
+    """
+    procs: dict = {}
+    restarts = {i: [] for i in range(workers)}
+    stopping = False
+
+    def handle_stop(signum, frame):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGTERM, handle_stop)
+    signal.signal(signal.SIGINT, handle_stop)
+
+    for i in range(workers):
+        procs[i] = _spawn(argv, i)
+    print(f"imaginary-tpu supervisor: {workers} workers "
+          f"(pids {[p.pid for p in procs.values()]})")
+
+    exit_code = 0
+    stop_deadline = None
+    while True:
+        if stopping:
+            # Re-signal every sweep rather than once in the handler: a
+            # SIGTERM that lands between a death check and its respawn
+            # would otherwise leave the replacement un-signaled and the
+            # supervisor waiting on it forever. SIGTERM is idempotent for
+            # the workers (their stop event just sets again). A worker
+            # whose drain wedges (e.g. stuck inside a hung accelerator
+            # runtime) gets SIGKILLed after the drain window + margin —
+            # without the escalation the supervisor would spin here until
+            # the platform kills the whole cgroup.
+            if stop_deadline is None:
+                stop_deadline = time.monotonic() + 15.0  # 5 s drain + margin
+            alive = [p for p in procs.values() if p.poll() is None]
+            if not alive:
+                break
+            hard = time.monotonic() > stop_deadline
+            for p in alive:
+                try:
+                    p.send_signal(signal.SIGKILL if hard else signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            time.sleep(0.1)
+            continue
+        # Sweep deaths BEFORE any liveness break: if every worker dies
+        # inside one interval (shared boot crash — bad mount, bad cert),
+        # the respawn/budget logic must still run; breaking on "none
+        # alive" first would report exit 0 for a fleet that never served.
+        for i, p in list(procs.items()):
+            rc = p.poll()
+            if rc is None or stopping:
+                continue
+            now = time.monotonic()
+            restarts[i] = [t for t in restarts[i] if now - t < 3600.0]
+            if len(restarts[i]) >= MAX_RESTARTS_PER_WORKER:
+                print(f"imaginary-tpu supervisor: worker {i} exceeded the "
+                      "restart budget; shutting down", file=sys.stderr)
+                exit_code = rc or 1
+                stopping = True
+                break
+            restarts[i].append(now)
+            print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
+                  f"exited {rc}; respawning", file=sys.stderr)
+            procs[i] = _spawn(argv, i)
+        time.sleep(0.2)
+
+    for p in procs.values():  # reap
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+    return exit_code

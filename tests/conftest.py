@@ -71,3 +71,14 @@ def psnr(a, b) -> float:
     if mse == 0:
         return 99.0
     return float(10.0 * np.log10(255.0 * 255.0 / mse))
+
+
+def free_port() -> int:
+    """Ephemeral TCP port for tests that boot real listeners."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -29,11 +29,9 @@ print("DIST_OK", jax.process_count(), dict(zip(mesh.axis_names, mesh.devices.sha
 
 
 def test_init_distributed_single_process_fleet():
-    import socket
+    from tests.conftest import free_port
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run(
@@ -113,11 +111,9 @@ def test_two_process_fleet_psum_and_sharded_chain():
     mesh, one cross-process psum, one dp-sharded chain step whose shards
     are bit-identical to the single-device oracle (SURVEY.md section 5.8;
     VERDICT r2 next #5)."""
-    import socket
+    from tests.conftest import free_port
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
@@ -160,6 +156,98 @@ def test_two_process_fleet_psum_and_sharded_chain():
     for rc, out, err in outs:
         assert "PSUM_OK True" in out
         assert "CHAIN_OK" in out
+
+
+_EXEC_WORKER = r"""
+import threading
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from imaginary_tpu.parallel.mesh import init_distributed
+
+PID = {pid}
+init_distributed(coordinator_address="127.0.0.1:{port}",
+                 num_processes=2, process_id=PID)
+assert jax.process_count() == 2
+
+# the SERVING executor inside a live fleet: micro-batch queue -> mesh
+# dispatch on this process's local chips (get_mesh(local=True)), while the
+# global 2-process backend stays up around it
+from imaginary_tpu.engine import Executor, ExecutorConfig
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops.plan import plan_operation
+
+ex = Executor(ExecutorConfig(window_ms=2.0, max_batch=8, use_mesh=True,
+                             host_spill=False))
+h_in, w_in = 32, 48
+plan = plan_operation("resize", ImageOptions(width=16, height=12, force=True),
+                      h_in, w_in, 0, 3)
+rng = np.random.default_rng(77 + PID)
+imgs = [rng.integers(0, 256, (h_in, w_in, 3), dtype=np.uint8) for _ in range(24)]
+oracle = [chain_mod.run_single(a, plan) for a in imgs]
+
+results = [None] * len(imgs)
+def client(k):
+    for j in range(k, len(imgs), 6):
+        results[j] = ex.process(imgs[j], plan)
+
+threads = [threading.Thread(target=client, args=(k,)) for k in range(6)]
+for t in threads: t.start()
+for t in threads: t.join()
+ex.shutdown()
+for got, want in zip(results, oracle):
+    assert got is not None and np.array_equal(got, want), "fleet executor output diverged"
+assert ex.stats.items == len(imgs)
+print("EXEC_FLEET_OK", ex.stats.items, ex.stats.batches)
+"""
+
+
+def test_two_process_fleet_serving_executors():
+    """Both fleet processes run the SERVING executor concurrently —
+    micro-batch queue, batch formation, mesh dispatch — against the
+    single-device oracle (VERDICT r4 next #7: test_distributed proved
+    init/psum/sharded-chain but never the Executor across processes)."""
+    import time
+
+    from tests.conftest import free_port
+
+    port = free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _EXEC_WORKER.format(pid=i, port=port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_ROOT, env=env,
+        )
+        for i in range(2)
+    ]
+    outs = [None, None]
+    deadline = time.monotonic() + 300
+    try:
+        while any(o is None for o in outs) and time.monotonic() < deadline:
+            for i, p in enumerate(procs):
+                if outs[i] is None and p.poll() is not None:
+                    out, err = p.communicate()
+                    outs[i] = (p.returncode, out, err)
+            if any(o is not None and o[0] != 0 for o in outs):
+                break
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, p in enumerate(procs):
+        if outs[i] is None:
+            out, err = p.communicate()
+            outs[i] = (p.returncode, out, err)
+    fails = [(rc, out, err) for rc, out, err in outs if rc != 0]
+    if any("distributed" in (err or "").lower() for _, _, err in fails):
+        pytest.skip(f"jax.distributed unavailable here: {fails[0][2][-200:]}")
+    assert not fails, "\n--- worker stderr ---\n".join(err[-2000:] for _, _, err in fails)
+    for rc, out, err in outs:
+        assert "EXEC_FLEET_OK 24" in out
 
 
 def test_cli_flags_thread_through():

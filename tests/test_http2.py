@@ -26,14 +26,6 @@ def _lib_present() -> bool:
     return load_nghttp2() is not None
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 @pytest.fixture(scope="module")
 def h2_server(tmp_path_factory, testdata):
     if not _lib_present():
@@ -47,7 +39,8 @@ def h2_server(tmp_path_factory, testdata):
          "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=localhost"],
         check=True, capture_output=True,
     )
-    port = _free_port()
+    from tests.conftest import free_port
+    port = free_port()
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, "-m", "imaginary_tpu", "--port", str(port),

@@ -547,11 +547,11 @@ class TestSpatialServedRequest:
 
 class TestTLSConfig:
     """TLS context mirrors the reference's pinned config (server.go:114-131):
-    TLS >= 1.2 and the ECDHE + AES-GCM/ChaCha20 cipher list. Curve
-    preferences stay at OpenSSL defaults (X25519-first anyway) — the ssl
-    module can't express a group list before 3.13. ALPN advertises
-    http/1.1 only — aiohttp has no h2 server and no h2 library exists in
-    this environment (documented gaps in PARITY.md)."""
+    TLS >= 1.2, the ECDHE + AES-GCM/ChaCha20 cipher list, and — on
+    Python >= 3.13, where ssl grew set_groups — the X25519/P-256/P-384
+    curve preference list; older interpreters keep OpenSSL's default
+    order (X25519-first anyway) rather than pinning wrong via the
+    single-curve set_ecdh_curve."""
 
     def test_ssl_context_pins_reference_ciphers(self, tmp_path):
         import ssl
@@ -583,6 +583,31 @@ class TestTLSConfig:
         from imaginary_tpu.web.app import make_ssl_context
 
         assert make_ssl_context(ServerOptions(cert_file="/tmp/x.crt")) is None
+
+    def test_group_pinning_on_py313(self):
+        """On >= 3.13 the context pins the reference's curve list via
+        set_groups; this interpreter may be older, so the helper is
+        proven against stand-ins on both sides of the version gate."""
+        import ssl as ssl_mod
+        import sys
+
+        from imaginary_tpu.web.app import _pin_groups
+
+        calls = []
+
+        class WithGroups:  # the >= 3.13 surface
+            def set_groups(self, groups):
+                calls.append(groups)
+
+        class WithoutGroups:  # pre-3.13 surface
+            pass
+
+        assert _pin_groups(WithGroups()) is True
+        assert calls == ["x25519:prime256v1:secp384r1"]
+        assert _pin_groups(WithoutGroups()) is False
+        # and the real context takes whichever branch this interpreter has
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        assert _pin_groups(ctx) is (sys.version_info >= (3, 13))
 
 
 class TestMultipartFieldOverride:

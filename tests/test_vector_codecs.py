@@ -65,11 +65,8 @@ class TestPDF:
         assert m.type == "pdf"
 
     def test_decode_pdf(self):
-        if not vb.pdf_available():
-            with pytest.raises(Exception) as ei:
-                codecs.decode(fixture_bytes("page.pdf"))
-            assert getattr(ei.value, "code", None) == 406
-            pytest.skip("poppler-glib not on host (gated 406 verified)")
+        # renders via poppler-glib when present, else the vendored
+        # classic-xref fallback (codecs/pdf_mini.py) — no skip either way
         d = codecs.decode(fixture_bytes("page.pdf"))
         assert d.array.shape == (160, 240, 4)
         # white page background; red rectangle block
@@ -77,6 +74,110 @@ class TestPDF:
         # content stream y=40..120 from PDF bottom -> rows 40..120 from top
         assert d.array[80, 120][0] > 180  # red-dominant
         assert d.array[80, 120][1] < 100
+
+    def test_resize_pdf_end_to_end(self):
+        """PDF in -> raster out through the live op pipeline."""
+        from imaginary_tpu.options import ImageOptions
+        from imaginary_tpu.pipeline import process_operation
+
+        o = ImageOptions(width=120, type="png")
+        o.mark_defined("width")
+        o.mark_defined("type")
+        out = process_operation("resize", fixture_bytes("page.pdf"), o)
+        import io
+
+        from PIL import Image
+
+        im = Image.open(io.BytesIO(out.body))
+        assert im.size[0] == 120
+
+
+def _mk_pdf(content: bytes, media=(0, 0, 240, 160), flate=False) -> bytes:
+    """Classic-xref single-page PDF builder (same shape gen_fixtures
+    writes) with arbitrary content and optional FlateDecode."""
+    import zlib as _zlib
+
+    extra = b""
+    data = content
+    if flate:
+        data = _zlib.compress(content)
+        extra = b" /Filter /FlateDecode"
+    objs = [
+        b"<< /Type /Catalog /Pages 2 0 R >>",
+        b"<< /Type /Pages /Kids [3 0 R] /Count 1 >>",
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [%d %d %d %d] "
+        b"/Contents 4 0 R >>" % media,
+        b"<< /Length " + str(len(data)).encode() + extra
+        + b" >>\nstream\n" + data + b"\nendstream",
+    ]
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = []
+    for i, body in enumerate(objs, start=1):
+        offsets.append(len(out))
+        out += str(i).encode() + b" 0 obj\n" + body + b"\nendobj\n"
+    xref_at = len(out)
+    out += b"xref\n0 " + str(len(objs) + 1).encode() + b"\n0000000000 65535 f \n"
+    for off in offsets:
+        out += ("%010d 00000 n \n" % off).encode()
+    out += (b"trailer\n<< /Size " + str(len(objs) + 1).encode()
+            + b" /Root 1 0 R >>\nstartxref\n" + str(xref_at).encode()
+            + b"\n%%EOF\n")
+    return bytes(out)
+
+
+class TestPdfMiniRenderer:
+    """The vendored fallback renderer (codecs/pdf_mini.py): classic-xref
+    vector subset at poppler geometry; default-closed on anything else."""
+
+    def test_transform_bezier_evenodd_flate(self):
+        from imaginary_tpu.codecs import pdf_mini
+
+        content = b"""
+q 1 0 0 1 20 20 cm
+0 0 1 rg
+0 0 m 100 0 l 100 100 l 0 100 l h f
+Q
+1 0 0 rg
+150 30 m 230 30 l 230 110 l 150 110 l h
+170 50 m 210 50 l 210 90 l 170 90 l h
+f*
+0 1 0 rg
+30 130 m 60 160 90 160 120 130 c 120 130 l 30 130 l h f
+"""
+        arr = pdf_mini.rasterize(_mk_pdf(content, flate=True))
+        assert tuple(arr[100, 60][:3]) == (0, 0, 255)    # cm-translated square
+        assert tuple(arr[120, 160][:3]) == (255, 0, 0)   # donut ring
+        assert tuple(arr[90, 190][:3]) == (255, 255, 255)  # even-odd hole
+        assert tuple(arr[20, 75][:3]) == (0, 255, 0)     # filled bezier region
+
+    @pytest.mark.parametrize("content,what", [
+        (b"BT /F1 12 Tf (Hi) Tj ET", "text"),
+        (b"/Im0 Do", "xobject/image"),
+        (b"/P1 scn", "pattern color"),
+        (b"0 0 240 160 re W n", "clipping"),
+    ])
+    def test_beyond_subset_is_refused(self, content, what):
+        from imaginary_tpu.codecs import pdf_mini
+
+        with pytest.raises(pdf_mini.UnsupportedPdf):
+            pdf_mini.rasterize(_mk_pdf(content))
+
+    def test_no_paint_operator_discards_path(self):
+        """'re n' must END the path — leaking it would paint a phantom
+        rectangle with the NEXT fill."""
+        from imaginary_tpu.codecs import pdf_mini
+
+        arr = pdf_mini.rasterize(
+            _mk_pdf(b"0 0 240 160 re n 0 0 1 rg 10 10 50 50 re f"))
+        assert tuple(arr[100, 200][:3]) == (255, 255, 255)  # page stays white
+        assert tuple(arr[120, 30][:3]) == (0, 0, 255)       # real fill lands
+
+    def test_beyond_subset_gates_406_through_codecs(self):
+        if vb.pdf_available():
+            pytest.skip("poppler present: renders for real, no gate")
+        with pytest.raises(Exception) as ei:
+            codecs.decode(_mk_pdf(b"BT ET"))
+        assert getattr(ei.value, "code", None) == 406
 
 
 class TestAVIF:
