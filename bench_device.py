@@ -141,8 +141,117 @@ def bench_chain(name, in_h, in_w, out_h, out_w, batches=(1, 8, 16, 32, 64)):
 # or delete on a loss"). The einsum path in ops/stages.py carries the note.
 
 
+def link_projection(live_rows=None) -> list:
+    """Co-located-link projection (VERDICT r4 next #1b): bridge the
+    measured on-chip rate to projected END-TO-END serving throughput per
+    link class, so "Nx on co-located hardware" is an evidenced
+    extrapolation instead of a hope.
+
+    Per-image wire bytes are computed from the REAL serving-path bucket
+    math (shrink-on-load decode of the 1080p headline workload, packed
+    YUV420 both ways — codecs/__init__.py layout). The on-chip rate
+    comes from live measurement when a chip is present, else from the
+    committed r4 hardware artifact. Link bandwidth/fixed-cost pairs are
+    labeled assumptions spanning the measured tunnel to co-located PCIe.
+
+        projected req/s = min(link rate, chip rate, host codec rate)
+        link rate  = 1 / (fixed_ms/batch + bytes/bandwidth)
+        host rate  = cores / host_fixed_ms   (decode+encode, measured)
+    """
+    from imaginary_tpu.ops.buckets import bucket_shape
+
+    # headline workload: 1080p JPEG -> /resize 300x200. The serving path
+    # decodes at 1/4 via DCT scaling (choose_decode_shrink) -> 270x480.
+    in_h, in_w = 270, 480
+    out_h, out_w = 200, 300
+    hb_i, wb_i = bucket_shape(in_h, in_w)
+    hb_o, wb_o = bucket_shape(out_h, out_w)
+    # packed YUV420 transport: (hb + hb/2) x wb bytes each way
+    bytes_in = (hb_i + hb_i // 2) * wb_i
+    bytes_out = (hb_o + hb_o // 2) * wb_o
+    wire_mb = (bytes_in + bytes_out) / 1e6
+
+    # measured on-chip rate (imgs/s at the serving batch) — live > artifact
+    chip_rate = 0.0
+    src = "live"
+    rows = live_rows or []
+    for r in rows:
+        if r.get("metric") == "device_chain_1080p_shrink4":
+            chip_rate = max(chip_rate, r.get("imgs_per_s_compute", 0.0))
+    if chip_rate == 0.0:
+        src = "artifacts/bench_device_r04_tpu.jsonl"
+        try:
+            with open(os.path.join("artifacts", "bench_device_r04_tpu.jsonl")) as f:
+                for line in f:
+                    r = json.loads(line)
+                    if r.get("metric") == "device_chain_1080p_shrink4":
+                        chip_rate = max(chip_rate, r.get("imgs_per_s_compute", 0.0))
+        except OSError:
+            pass
+    if chip_rate == 0.0:
+        chip_rate = 1306.8  # r4 full-1080p batch-64 row (conservative)
+        src = "r4 full-1080p row (fallback)"
+
+    # measured host codec cost per image (probe+decode+encode) and the
+    # cv2 baseline from the SAME decomposition artifact, so the two
+    # columns can never drift apart; hardcoded r5 measurements only when
+    # no artifact exists. Per-file error handling: one malformed artifact
+    # must not silently skip a valid sibling.
+    host_fixed_ms = 2.47
+    base_ms = 11.32
+    for name in ("host_ceiling_tpu.json", "host_ceiling_cpu.json",
+                 "host_ceiling_cpu-fallback.json"):
+        try:
+            with open(os.path.join("artifacts", name)) as f:
+                art = json.load(f)
+            host_fixed_ms = art["ours"]["host_fixed_ms"]
+            base_ms = art["cv2_baseline"]["total_ms"]
+            break
+        except (OSError, KeyError, ValueError):
+            continue
+    links = [
+        # (label, MB/s, fixed ms per drain) — tunnel numbers are MEASURED
+        ("tunnel_measured", 30.0, 60.0),
+        ("dcn_1GBps", 1000.0, 5.0),
+        ("pcie3_x16", 12000.0, 0.5),
+        ("colocated_pcie5", 48000.0, 0.2),
+    ]
+    out = []
+    serving_batch = 16
+    for label, mbps, fixed_ms in links:
+        link_rate = 1000.0 / (fixed_ms / serving_batch + wire_mb / mbps * 1000.0)
+        for cores in (1, 8, 32):
+            host_rate = cores * 1000.0 / host_fixed_ms
+            e2e = min(link_rate, chip_rate, host_rate)
+            bound = ("link" if e2e == link_rate
+                     else "chip" if e2e == chip_rate else "host-codecs")
+            row = {
+                "metric": "link_projection_resize_1080p",
+                "link": label,
+                "link_mb_per_s": mbps,
+                "drain_fixed_ms": fixed_ms,
+                "host_cores": cores,
+                "wire_mb_per_img": round(wire_mb, 4),
+                "chip_imgs_per_s": round(chip_rate, 1),
+                "chip_rate_source": src,
+                "projected_req_per_s": round(e2e, 1),
+                "bound_by": bound,
+                "vs_1core_cv2_baseline": round(e2e / (1000.0 / base_ms), 2),
+            }
+            out.append(row)
+            log(f"[dev] proj {label:>16} cores={cores:<3} -> "
+                f"{row['projected_req_per_s']:>8} req/s ({bound})")
+            print(json.dumps(row), flush=True)
+    return out
+
+
 def main():
     platform = os.environ.get("BENCH_PLATFORM", "")
+    if os.environ.get("BENCH_PROJECTION_ONLY") == "1":
+        # the projection needs no chip: it bridges the RECORDED on-chip
+        # artifact to e2e rates per link class
+        link_projection()
+        return 0
     if not platform:
         if not _probe_accelerator():
             log("[dev] *** ACCELERATOR UNREACHABLE — refusing to run; set "
@@ -166,9 +275,11 @@ def main():
         return 0
 
     # the three serving buckets: full 1080p, its 1/4 shrink, 4K
-    bench_chain("1080p", 1080, 1920, 200, 300)
-    bench_chain("1080p_shrink4", 270, 480, 200, 300, batches=(1, 16, 64))
-    bench_chain("4k", 2160, 3840, 480, 854, batches=(1, 8, 16))
+    rows = []
+    rows += bench_chain("1080p", 1080, 1920, 200, 300)
+    rows += bench_chain("1080p_shrink4", 270, 480, 200, 300, batches=(1, 16, 64))
+    rows += bench_chain("4k", 2160, 3840, 480, 854, batches=(1, 8, 16))
+    link_projection(rows)
     return 0
 
 

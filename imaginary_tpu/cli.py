@@ -119,6 +119,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-endpoints", default="", help="CSV of endpoints to disable")
     p.add_argument("--version", action="store_true")
     # TPU engine flags (no reference counterpart)
+    p.add_argument("--max-queue-ms", type=float, default=0.0,
+                   help="shed load (503) when estimated queueing delay "
+                        "exceeds this; 0 disables")
     p.add_argument("--workers", type=int, default=1,
                    help="serving processes on one port via SO_REUSEPORT "
                         "(0 = one per CPU core); worker 0 owns the device, "
@@ -214,6 +217,7 @@ def options_from_args(args) -> ServerOptions:
         cpus=args.cpus,
         endpoints=parse_endpoints(args.disable_endpoints),
         workers=_resolve_workers(args.workers),
+        max_queue_ms=max(0.0, args.max_queue_ms),
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,

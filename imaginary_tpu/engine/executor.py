@@ -362,6 +362,19 @@ class Executor:
 
     # -- public API ------------------------------------------------------------
 
+    def estimated_wait_ms(self) -> float:
+        """Estimated device-path QUEUEING delay for a new arrival: the
+        owed-work ledger (ms of enqueued, undrained device work, charged
+        per item at its own measured rate). Deliberately excludes the
+        link's fixed drain floor — that is per-request SERVICE cost (the
+        placement comparison includes it; _should_spill), and counting it
+        here would make an idle server on a slow link read as permanently
+        backlogged (measured: a CPU-fallback floor of ~670 ms latched the
+        --max-queue-ms admission gate shut forever after one burst).
+        Exposed for the web-layer admission gate and /health."""
+        with self._owed_lock:
+            return self._owed_ms
+
     def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
         """Enqueue one image; resolves to the output HWC uint8 array.
 

@@ -52,6 +52,10 @@ class ServerOptions:
     # serving processes sharing the port via SO_REUSEPORT (web/workers.py);
     # >1 makes every listener bind with reuse_port
     workers: int = 1
+    # depth-based admission control: 503 new arrivals when the estimated
+    # queueing delay (host backlog + device owed-work ledger) exceeds this
+    # many ms; 0 disables (GCRA still bounds the RATE either way)
+    max_queue_ms: float = 0.0
     # --- TPU engine knobs (no reference counterpart) -------------------------
     batch_window_ms: float = 3.0
     # default mirrors engine.executor.MAX_BATCH (kept literal here so this

@@ -746,3 +746,70 @@ class TestBootLivenessGate:
         cli._finish_device_probe(proc)
         assert "jax.config.update('jax_platforms', 'cpu')" in captured["code"]
         assert "assert" not in captured["code"]  # accel check only when asked
+
+
+class TestQueueDepthAdmission:
+    """--max-queue-ms sheds load with a 503 when the estimated queueing
+    delay (host backlog + executor owed-work ledger) exceeds the bound —
+    GCRA caps the RATE, this caps the DEPTH an overload can pile up
+    (r4 weak: closed-loop p99 reached 450+ ms unbounded)."""
+
+    def test_overloaded_queue_sheds_with_503(self):
+        async def fn(client, _):
+            svc = client.app["service"]
+            svc._service_ewma_ms = 10_000.0  # simulate a saturated pool...
+            svc._inflight = svc._pool_workers + 50  # ...with deep backlog
+            resp = await client.post(
+                "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+            assert resp.status == 503
+            body = await resp.json()
+            assert body["message"] == "Server queue is full, retry later"
+
+        run(ServerOptions(max_queue_ms=200.0), fn)
+
+    def test_quiet_queue_admits(self):
+        async def fn(client, _):
+            resp = await client.post(
+                "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+            assert resp.status == 200
+
+        run(ServerOptions(max_queue_ms=200.0), fn)
+
+    def test_disabled_by_default(self):
+        async def fn(client, _):
+            svc = client.app["service"]
+            svc._service_ewma_ms = 10_000.0
+            svc._inflight = svc._pool_workers + 50
+            resp = await client.post(
+                "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+            assert resp.status == 200  # 0 = no depth gate (r4 behavior)
+            svc._inflight = 0
+
+        run(ServerOptions(), fn)
+
+    def test_estimate_combines_host_and_device(self):
+        async def fn(client, _):
+            svc = client.app["service"]
+            base = svc.estimated_queue_ms()
+            svc._inflight = svc._pool_workers + svc._pool_workers  # backlog = workers
+            bumped = svc.estimated_queue_ms()
+            assert bumped >= base + svc._service_ewma_ms * 0.9
+            svc._inflight = 0
+
+        run(ServerOptions(), fn)
+
+    def test_gate_recovers_when_queue_drains(self):
+        """Regression: the estimate must exclude the link's fixed drain
+        floor — on a slow backend (CPU-fallback floor ~670 ms) counting
+        it latched the gate shut FOREVER after one burst (an idle server
+        reading as permanently backlogged)."""
+        async def fn(client, _):
+            svc = client.app["service"]
+            # a slow link's fixed floor, far above the bound
+            svc.executor._drain_floor_ms = 700.0
+            assert svc.executor.estimated_wait_ms() == 0.0  # floor excluded
+            resp = await client.post(
+                "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+            assert resp.status == 200  # idle server admits despite floor
+
+        run(ServerOptions(max_queue_ms=150.0), fn)
