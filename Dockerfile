@@ -14,7 +14,7 @@
 FROM python:3.12-slim-bookworm AS build
 
 RUN apt-get update && apt-get install -y --no-install-recommends \
-    g++ make libjpeg62-turbo-dev libpng-dev libwebp-dev \
+    g++ make libjpeg62-turbo-dev libpng-dev libwebp-dev libtiff-dev \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /src
@@ -37,7 +37,7 @@ FROM python:3.12-slim-bookworm
 # codecs/vector_backend.py), codec shared objects for the native extension,
 # and real truetype fonts for pango-style watermark specs (ops/text.py).
 RUN apt-get update && apt-get install -y --no-install-recommends \
-    libjpeg62-turbo libpng16-16 libwebp7 \
+    libjpeg62-turbo libpng16-16 libwebp7 libtiff6 \
     librsvg2-2 libcairo2 libpoppler-glib8 libheif1 \
     libnghttp2-14 \
     fonts-dejavu-core curl \
