@@ -479,7 +479,19 @@ def _exec_content(data: bytes, canvas, base_ctm):
 
 def rasterize(buf: bytes, page_index: int = 0) -> np.ndarray:
     """First page -> RGBA uint8 at 72 dpi over a white background
-    (poppler pdfload geometry). Raises UnsupportedPdf beyond the subset."""
+    (poppler pdfload geometry). Raises UnsupportedPdf both beyond the
+    subset and for malformed input (corrupt bytes are a refusal, not a
+    crash); genuine bug classes (RecursionError, MemoryError,
+    AssertionError) propagate so the fuzz suite can catch them."""
+    try:
+        return _rasterize(buf, page_index)
+    except (UnsupportedPdf, RecursionError, MemoryError, AssertionError):
+        raise
+    except Exception as e:
+        raise UnsupportedPdf(f"malformed pdf: {type(e).__name__}") from e
+
+
+def _rasterize(buf: bytes, page_index: int) -> np.ndarray:
     doc = _Doc(buf)
     root = doc.obj(doc.trailer.get("/Root"))
     if not isinstance(root, dict):

@@ -180,3 +180,33 @@ def test_vector_decode_truncations_never_crash(testdata):
                 codecs.decode(mutated, 1)
             except ImageError:
                 pass
+
+
+def test_pdf_mini_fuzz_never_crashes(testdata):
+    """The vendored PDF renderer (codecs/pdf_mini.py) is hand-written
+    parsing over untrusted bytes — render-or-UnsupportedPdf, never a
+    crash or hang. Calls the parser DIRECTLY (codecs.decode would route
+    to poppler where installed and its blanket except would launder
+    parser crashes into 400s); only UnsupportedPdf is caught, so an
+    escaping IndexError/RecursionError fails the test."""
+    from imaginary_tpu.codecs import pdf_mini
+    from tests.conftest import fixture_bytes
+
+    buf = fixture_bytes("page.pdf")
+    for cut in _cuts(buf):
+        try:
+            arr = pdf_mini.rasterize(buf[:cut])
+            assert arr.ndim == 3
+        except pdf_mini.UnsupportedPdf:
+            pass
+    rng = np.random.default_rng(17)
+    for _ in range(120):
+        pos = int(rng.integers(0, len(buf)))
+        bit = 1 << int(rng.integers(0, 8))
+        m = buf[:pos] + bytes([buf[pos] ^ bit]) + buf[pos + 1:]
+        try:
+            pdf_mini.rasterize(m)
+        except pdf_mini.UnsupportedPdf:
+            pass
+    # the intact fixture still renders
+    assert pdf_mini.rasterize(buf).shape == (160, 240, 4)
