@@ -300,3 +300,71 @@ class TestTiffOrientation:
         Image.fromarray(arr).save(buf, "TIFF", tiffinfo={274: 3})
         d = codecs.decode(buf.getvalue())
         assert d.array[-1, 0, 0] == 255 and d.array[0, 0, 0] == 0
+
+
+class TestCodecEdgeGeometry:
+    """Decoder paths beyond the common layouts: 16-bit TIFF (RGBA-reader
+    fallback) and a GIF frame smaller than its logical screen at an
+    offset (background composition), graded against PIL."""
+
+    def test_16bit_tiff_decodes_via_fallback(self):
+        g16 = np.linspace(0, 65535, 50 * 60).reshape(50, 60).astype(np.uint16)
+        b = io.BytesIO()
+        Image.fromarray(g16).save(b, "TIFF")
+        d = codecs.decode(b.getvalue())
+        assert d.array.shape[:2] == (50, 60) and d.array.shape[2] in (3, 4)
+
+    def test_gif_frame_offset_composites_on_background(self):
+        import struct
+
+        def sub_blocks(data):
+            out = b""
+            for i in range(0, len(data), 255):
+                chunk = data[i:i + 255]
+                out += bytes([len(chunk)]) + chunk
+            return out + b"\x00"
+
+        def lzw(indices, mcs):
+            clear, eoi = 1 << mcs, (1 << mcs) + 1
+            cs, nxt, table, bits = mcs + 1, eoi + 1, {}, []
+            bits.append((clear, cs))
+            prefix = (indices[0],)
+            for ch in indices[1:]:
+                cand = prefix + (ch,)
+                if cand in table:
+                    prefix = cand
+                    continue
+                bits.append((table[prefix] if len(prefix) > 1 else prefix[0], cs))
+                if nxt >= (1 << cs) and cs < 12:
+                    cs += 1
+                if nxt < 4096:
+                    table[cand] = nxt
+                    nxt += 1
+                prefix = (ch,)
+            bits.append((table[prefix] if len(prefix) > 1 else prefix[0], cs))
+            if nxt >= (1 << cs) and cs < 12:
+                cs += 1
+            bits.append((eoi, cs))
+            acc = nb = 0
+            out = bytearray()
+            for code, w in bits:
+                acc |= code << nb
+                nb += w
+                while nb >= 8:
+                    out.append(acc & 255)
+                    acc >>= 8
+                    nb -= 8
+            if nb:
+                out.append(acc & 255)
+            return bytes(out)
+
+        # 10x8 screen, white bg + red; red 4x3 frame at (3,2)
+        gif = b"GIF89a" + struct.pack("<HH", 10, 8) + bytes([0x80, 0, 0])
+        gif += bytes([255, 255, 255, 255, 0, 0])
+        gif += b"\x2C" + struct.pack("<HHHH", 3, 2, 4, 3) + b"\x00"
+        gif += bytes([2]) + sub_blocks(lzw([1] * 12, 2)) + b"\x3B"
+        d = codecs.decode(gif)
+        pil = np.asarray(Image.open(io.BytesIO(gif)).convert("RGB"))
+        assert np.array_equal(d.array[..., :3], pil)
+        assert tuple(d.array[0, 0, :3]) == (255, 255, 255)  # background
+        assert tuple(d.array[3, 4, :3]) == (255, 0, 0)      # offset frame
