@@ -37,6 +37,9 @@ ROUTES = [
     ("resize", "/resize?width=300&height=200", "POST"),
     ("crop", "/crop?width=400&height=300", "POST"),
     ("extract", "/extract?top=100&left=100&areawidth=600&areaheight=400", "POST"),
+    # the reference's documented WORST op ("enlarge degrades under
+    # >20 req/s", README.md:306): 1080p -> 2560x1440 upscale
+    ("enlarge", "/enlarge?width=2560&height=1440", "POST"),
     (
         "pipeline",
         "/pipeline?operations=" + quote(
@@ -192,6 +195,11 @@ def _cv2_workloads(buf_1080: bytes, buf_4k) -> dict:
         a = cv2.imdecode(d1080, cv2.IMREAD_COLOR)
         cv2.imencode(".jpg", a[100:500, 100:700], jq)
 
+    def enlarge():
+        a = cv2.imdecode(d1080, cv2.IMREAD_COLOR)
+        cv2.imencode(".jpg", cv2.resize(a, (2560, 1440),
+                                        interpolation=cv2.INTER_CUBIC), jq)
+
     def pipeline():
         a = cv2.imdecode(d1080, cv2.IMREAD_COLOR)
         h, w = a.shape[:2]
@@ -212,6 +220,7 @@ def _cv2_workloads(buf_1080: bytes, buf_4k) -> dict:
         "resize": (resize, 1.0),
         "crop": (crop, 1.0),
         "extract": (extract, 1.0),
+        "enlarge": (enlarge, 1.0),
         "pipeline": (pipeline, 1.0),
         "mixed_thumb_crop_rotate": (mixed, 3.0),  # 3 requests per call
     }
