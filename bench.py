@@ -155,7 +155,12 @@ def main():
     import subprocess
 
     root = os.path.dirname(os.path.abspath(__file__))
-    if not glob.glob(os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs*.so")):
+    src = os.path.join(root, "imaginary_tpu", "native", "codecs.cpp")
+    sos = glob.glob(os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs*.so"))
+    # rebuild on a MISSING or STALE extension: an old-ABI .so would make
+    # native_backend report unavailable and silently demote the bench to
+    # the cv2 codec backend
+    if not sos or os.path.getmtime(src) > os.path.getmtime(sos[0]):
         try:
             r = subprocess.run([sys.executable, "-m", "imaginary_tpu.native.build"],
                                timeout=180, capture_output=True, cwd=root)

@@ -85,3 +85,14 @@ def run_workers(call, duration: float, n_threads: int):
         t.join()
     elapsed = time.monotonic() - t0
     return sum(counts) / elapsed, [x for sub in lats for x in sub]
+
+
+def free_port() -> int:
+    """Ephemeral TCP port (shared by bench harnesses and tests)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

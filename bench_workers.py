@@ -20,21 +20,12 @@ from __future__ import annotations
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
 import urllib.request
 
-from bench_util import make_1080p_jpeg, pctl, run_workers
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from bench_util import free_port, make_1080p_jpeg, pctl, run_workers
 
 
 def _wait_healthy(port: int, deadline_s: float = 120.0) -> None:
@@ -49,7 +40,7 @@ def _wait_healthy(port: int, deadline_s: float = 120.0) -> None:
 
 
 def bench_n(n: int, body: bytes, duration: float, n_threads: int) -> dict:
-    port = _free_port()
+    port = free_port()
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", env.get("BENCH_PLATFORM", "cpu"))
     env.pop("IMAGINARY_TPU_WORKER", None)

@@ -98,24 +98,21 @@ class _Lexer:
         return "/" + self._word().decode("latin-1")
 
     def _number_or_ref(self):
-        save = self.p
         first = self._word()
         try:
             n = float(first) if b"." in first else int(first)
         except ValueError:
             raise UnsupportedPdf(f"bad number {first[:16]!r}") from None
         if isinstance(n, int) and n >= 0:
-            # lookahead for "G R"
-            save2 = self.p
+            # lookahead for "G R" (indirect reference)
+            save = self.p
             self._skip_ws()
             gen = self._word()
             if gen.isdigit():
                 self._skip_ws()
-                r = self._word()
-                if r == b"R":
+                if self._word() == b"R":
                     return _Ref(n)
-            self.p = save2
-        self.p = save + len(first)
+            self.p = save
         return n
 
     def _array(self):

@@ -75,10 +75,6 @@ def psnr(a, b) -> float:
 
 def free_port() -> int:
     """Ephemeral TCP port for tests that boot real listeners."""
-    import socket
+    from bench_util import free_port as _fp
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return _fp()
