@@ -151,16 +151,23 @@ def main():
     n_threads = int(os.environ.get("BENCH_THREADS", str(max(32, 4 * cpus))))
 
     # build the native codec extension if missing (gitignored artifact)
-    import glob
     import subprocess
+
+    import sysconfig
 
     root = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(root, "imaginary_tpu", "native", "codecs.cpp")
-    sos = glob.glob(os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs*.so"))
+    # THIS interpreter's extension filename (a leftover .so from another
+    # Python version must not satisfy the check)
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs" + suffix)
     # rebuild on a MISSING or STALE extension: an old-ABI .so would make
     # native_backend report unavailable and silently demote the bench to
-    # the cv2 codec backend
-    if not sos or os.path.getmtime(src) > os.path.getmtime(sos[0]):
+    # the cv2 codec backend; a missing codecs.cpp (deployed artifact)
+    # keeps whatever .so is present
+    stale = os.path.exists(src) and (
+        not os.path.exists(so) or os.path.getmtime(src) > os.path.getmtime(so))
+    if stale:
         try:
             r = subprocess.run([sys.executable, "-m", "imaginary_tpu.native.build"],
                                timeout=180, capture_output=True, cwd=root)

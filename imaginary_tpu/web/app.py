@@ -65,6 +65,7 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     add(prefix + "/" if prefix else "/", partial(_index, o))
     add(prefix + "/form", partial(_form, o), methods=("GET",))
     add(prefix + "/health", partial(_health, service), methods=("GET",))
+    add(prefix + "/metrics", partial(_metrics, service), methods=("GET",))
 
     for name in ALL_OPERATIONS:
         route = "/" + (name.lower() if name == "watermarkImage" else name)
@@ -84,6 +85,15 @@ async def _form(o, request):
 
 async def _health(service, request):
     return await health_controller(request, service)
+
+
+async def _metrics(service, request):
+    # same numbers as /health, Prometheus exposition format (web/metrics.py)
+    from imaginary_tpu.web.handlers import collect_health_stats
+    from imaginary_tpu.web.metrics import render_metrics
+
+    return web.Response(text=render_metrics(collect_health_stats(service)),
+                        content_type="text/plain", charset="utf-8")
 
 
 async def _image(service, name, request):

@@ -269,13 +269,19 @@ async def index_controller(request: web.Request, o: ServerOptions) -> web.Respon
     return web.json_response(current_versions().to_dict())
 
 
-async def health_controller(request: web.Request, service: Optional[ImageService]) -> web.Response:
+def collect_health_stats(service: Optional[ImageService]) -> dict:
+    """The ONE stats assembly /health and /metrics both serve (they must
+    never drift — /metrics promises 'the same numbers as /health')."""
     stats = get_health_stats(service.executor if service else None)
     if service is not None:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads
         stats["estimatedQueueMs"] = round(service.estimated_queue_ms(), 2)
-    return web.json_response(stats)
+    return stats
+
+
+async def health_controller(request: web.Request, service: Optional[ImageService]) -> web.Response:
+    return web.json_response(collect_health_stats(service))
 
 
 async def form_controller(request: web.Request, o: ServerOptions) -> web.Response:

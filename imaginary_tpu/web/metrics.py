@@ -1,0 +1,52 @@
+"""Prometheus text-format /metrics endpoint.
+
+ABOVE-REFERENCE: the reference has no Prometheus surface (SURVEY.md
+section 5.5 — operators are pointed at a fluentd log recipe). This
+renders the SAME numbers /health serves, in exposition format 0.0.4, so
+the fleet can be scraped without a sidecar. The mapping is mechanical:
+health's camelCase keys become snake_case gauges under the
+`imaginary_tpu_` namespace, executor counters become
+`imaginary_tpu_executor_*`, and per-stage latency percentiles become
+labeled `imaginary_tpu_stage_ms{stage=...,q=...}` gauges.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
+
+
+def _emit(lines: list, name: str, value, labels: str = "") -> None:
+    if isinstance(value, bool):
+        value = int(value)
+    if not isinstance(value, (int, float)):
+        return
+    lines.append(f"{name}{{{labels}}} {value}" if labels else f"{name} {value}")
+
+
+def render_metrics(stats: dict) -> str:
+    """Health-stats dict -> Prometheus exposition text."""
+    lines: list = []
+    for key, value in stats.items():
+        if key == "executor" and isinstance(value, dict):
+            for k, v in value.items():
+                _emit(lines, f"imaginary_tpu_executor_{_snake(k)}", v)
+        elif key == "stageTimesMs" and isinstance(value, dict):
+            for stage, pcts in value.items():
+                for q, v in pcts.items():
+                    if q == "count":
+                        # dimensionless counter: its own series, never
+                        # mixed into the milliseconds gauge family
+                        _emit(lines, "imaginary_tpu_stage_total", v,
+                              f'stage="{stage}"')
+                    else:
+                        _emit(lines, "imaginary_tpu_stage_ms", v,
+                              f'stage="{stage}",q="{_snake(q).replace("_ms", "")}"')
+        elif key == "backend":
+            _emit(lines, "imaginary_tpu_backend_info", 1, f'backend="{value}"')
+        else:
+            _emit(lines, f"imaginary_tpu_{_snake(key)}", value)
+    return "\n".join(lines) + "\n"

@@ -30,7 +30,9 @@ from imaginary_tpu.errors import (
 from imaginary_tpu.version import Version
 from imaginary_tpu.web.config import ServerOptions
 
-PUBLIC_PATHS = ("/", "/health", "/form")  # ref: middleware.go:231-238
+# ref: middleware.go:231-238; /metrics is ours (Prometheus surface the
+# reference lacks) and is public like /health
+PUBLIC_PATHS = ("/", "/health", "/form", "/metrics")
 
 
 def is_public_path(o: ServerOptions, path: str) -> bool:

@@ -813,3 +813,46 @@ class TestQueueDepthAdmission:
             assert resp.status == 200  # idle server admits despite floor
 
         run(ServerOptions(max_queue_ms=150.0), fn)
+
+
+
+class TestMetricsEndpoint:
+    """Prometheus /metrics (above-reference: SURVEY 5.5 notes the
+    reference has no Prometheus surface). Same numbers as /health in
+    exposition format; public like /health."""
+
+    def test_metrics_shape(self):
+        async def fn(client, _):
+            # process one image so executor counters are live
+            await client.post("/resize?width=100", data=multipart_jpg())
+            res = await client.get("/metrics")
+            assert res.status == 200
+            assert res.headers["Content-Type"].startswith("text/plain")
+            text = await res.text()
+            lines = dict(
+                ln.rsplit(" ", 1) for ln in text.strip().splitlines()
+                if " " in ln and not ln.startswith("#")
+            )
+            assert float(lines["imaginary_tpu_uptime"]) >= 0
+            assert "imaginary_tpu_pid" in lines
+            assert float(lines["imaginary_tpu_executor_items"]) >= 0
+            assert float(lines["imaginary_tpu_estimated_queue_ms"]) >= 0
+            assert any(k.startswith('imaginary_tpu_backend_info{backend=')
+                       for k in lines)
+            # per-stage latency gauges carry stage/quantile labels
+            assert any(k.startswith('imaginary_tpu_stage_ms{stage="')
+                       for k in lines)
+
+        run(ServerOptions(), fn)
+
+    def test_metrics_gated_like_health(self):
+        """Exactly /health's auth posture: the reference wires ALL routes
+        through the API-key middleware (server.go:73-76), so a scraper
+        needs the key when one is set."""
+        async def fn(client, _):
+            res = await client.get("/metrics")
+            assert res.status == 401
+            res = await client.get("/metrics", headers={"API-Key": "sekrit"})
+            assert res.status == 200
+
+        run(ServerOptions(api_key="sekrit"), fn)
