@@ -108,6 +108,15 @@ def parse_origins(value: str) -> tuple:
             parts = path.split("/", 1)
             host = parts[0]
             path = "/" + parts[1] if len(parts) > 1 else ""
+        if path:
+            # ref: imaginary.go:314-321 — a trailing "*" turns the path
+            # into a raw prefix ("/bucket*" matches "/bucket-a/.."), and
+            # anything else gets a trailing "/" so "/assets" can never
+            # leak "/assetsevil/.." through the prefix check
+            if path.endswith("*"):
+                path = path[:-1]
+            elif not path.endswith("/"):
+                path += "/"
         origins.append((host, path))
     return tuple(origins)
 

@@ -856,3 +856,70 @@ class TestMetricsEndpoint:
             assert res.status == 200
 
         run(ServerOptions(api_key="sekrit"), fn)
+
+
+class TestShouldRestrictOriginMatrix:
+    """The reference's full allowed-origins matrix, ported verbatim
+    (source_http_test.go:300-443): wildcard subdomains, path prefixes,
+    double slashes, trailing-slash normalization, bucket pairs, and the
+    trailing-* path wildcard (parseOrigins strips it to a raw prefix,
+    imaginary.go:314-321 — r5 fix: our parse previously kept both the
+    `*` and the missing-slash laxness, so `/assets` wrongly admitted
+    `/assetsevil/..`)."""
+
+    def _restricted(self, url, origins_csv):
+        from urllib.parse import urlparse as up
+
+        from imaginary_tpu.web.sources import should_restrict_origin
+
+        return should_restrict_origin(up(url), parse_origins(origins_csv))
+
+    PLAIN = "https://example.org"
+    WILD = ("https://localhost,https://*.example.org,"
+            "https://some.s3.bucket.on.aws.org,https://*.s3.bucket.on.aws.org")
+    WITH_PATH = ("https://localhost/foo/bar/,https://*.example.org/foo/,"
+                 "https://some.s3.bucket.on.aws.org/my/bucket/,"
+                 "https://*.s3.bucket.on.aws.org/my/bucket/,"
+                 "https://no-leading-path-slash.example.org/assets")
+    TWO_BUCKETS = ("https://some.s3.bucket.on.aws.org/my/bucket1/,"
+                   "https://some.s3.bucket.on.aws.org/my/bucket2/")
+    PATH_WILDCARD = "https://some.s3.bucket.on.aws.org/my-bucket-name*"
+
+    @pytest.mark.parametrize("url,origins,allowed", [
+        # plain origin
+        ("https://example.org/logo.jpg", PLAIN, True),
+        # wildcard origin, plain / sub / sub-sub domain URLs
+        ("https://example.org/logo.jpg", WILD, True),
+        ("https://node-42.example.org/logo.jpg", WILD, True),
+        ("https://n.s3.bucket.on.aws.org/our/bucket/logo.jpg", WILD, True),
+        # incorrect domain: restricted under both configs
+        ("https://myexample.org/logo.jpg", PLAIN, False),
+        ("https://myexample.org/logo.jpg", WILD, False),
+        # loopback origin with path
+        ("https://localhost/foo/bar/logo.png", WITH_PATH, True),
+        ("https://localhost/wrong/logo.png", WITH_PATH, False),
+        # wildcard origin with (partial) path
+        ("https://our.company.s3.bucket.on.aws.org/my/bucket/logo.gif",
+         WITH_PATH, True),
+        ("https://our.company.s3.bucket.on.aws.org/my/bucket/a/b/c/d/e/logo.gif",
+         WITH_PATH, True),
+        # double slashes inside the URL path
+        ("https://static.example.org/foo//a//b//c/d/e/logo.webp",
+         WITH_PATH, True),
+        # origin path missing its trailing slash still matches its subtree
+        ("https://no-leading-path-slash.example.org/assets/logo.webp",
+         "https://*.example.org/assets", True),
+        # ...but must NOT leak prefix-sibling paths (normalization adds /)
+        ("https://no-leading-path-slash.example.org/assetsevil/logo.webp",
+         "https://*.example.org/assets", False),
+        # two buckets on one host
+        ("https://some.s3.bucket.on.aws.org/my/bucket1/logo.jpg", TWO_BUCKETS, True),
+        ("https://some.s3.bucket.on.aws.org/my/bucket2/logo.jpg", TWO_BUCKETS, True),
+        # trailing-* path wildcard: raw prefix
+        ("https://some.s3.bucket.on.aws.org/my-bucket-name/logo.jpg",
+         PATH_WILDCARD, True),
+        ("https://some.s3.bucket.on.aws.org/my-other-bucket-name/logo.jpg",
+         PATH_WILDCARD, False),
+    ])
+    def test_matrix(self, url, origins, allowed):
+        assert self._restricted(url, origins) is (not allowed)
