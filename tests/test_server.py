@@ -923,3 +923,50 @@ class TestShouldRestrictOriginMatrix:
     ])
     def test_matrix(self, url, origins, allowed):
         assert self._restricted(url, origins) is (not allowed)
+
+
+class TestAccessLogContract:
+    """log_test.go ported: info level logs a 200 line carrying method,
+    HTTP version and status; error level emits NOTHING for a 200
+    (log.go:88-99). Plus the level gates the reference implies but never
+    tests: warning catches 4xx, error catches 5xx."""
+
+    def _capture(self, level, fn_inner):
+        stream = io.StringIO()
+
+        async def runner():
+            app = create_app(ServerOptions(log_level=level), log_stream=stream)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                await fn_inner(client)
+            finally:
+                await client.close()
+
+        asyncio.run(runner())
+        return stream.getvalue()
+
+    def test_info_logs_full_line(self):
+        async def fn(client):
+            await client.get("/health")
+
+        line = self._capture("info", fn)
+        assert "GET" in line and "HTTP/1.1" in line and " 200 " in line
+        # Apache-ish shape with 4-decimal latency (log.go:12,31)
+        import re
+
+        assert re.search(r'" 200 \d+ \d+\.\d{4}\n', line)
+
+    def test_error_level_silent_on_200(self):
+        async def fn(client):
+            await client.get("/health")
+
+        assert self._capture("error", fn) == ""
+
+    def test_warning_catches_4xx_not_2xx(self):
+        async def fn(client):
+            await client.get("/health")          # 200: silent
+            await client.get("/bogus-route")     # 404: logged
+
+        line = self._capture("warning", fn)
+        assert " 200 " not in line and " 404 " in line
