@@ -970,3 +970,61 @@ class TestAccessLogContract:
 
         line = self._capture("warning", fn)
         assert " 200 " not in line and " 404 " in line
+
+
+class TestMaxAllowedSize:
+    """source_http_test.go:270-298 ported: a remote image larger than
+    -max-allowed-size must be refused via the HEAD Content-Length
+    pre-check (source_http.go:83-87,105-124), exercised with the
+    1024-byte fixture against a 1023-byte cap."""
+
+    def test_oversized_remote_rejected(self):
+        from aiohttp import web
+
+        blob = fixture_bytes("1024bytes")
+
+        async def origin(request):
+            return web.Response(body=blob,
+                                content_type="application/octet-stream")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
+            assert res.status == 400
+            body = await res.json()
+            assert "exceeds maximum allowed" in body["message"]
+
+        run(ServerOptions(enable_url_source=True, max_allowed_size=1023),
+            fn, origin_handler=origin)
+
+    def test_within_cap_fetches(self):
+        from aiohttp import web
+
+        blob = fixture_bytes("imaginary.jpg")
+
+        async def origin(request):
+            return web.Response(body=blob, content_type="image/jpeg")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
+            assert res.status == 200
+
+        run(ServerOptions(enable_url_source=True,
+                          max_allowed_size=len(blob) + 100),
+            fn, origin_handler=origin)
+
+    def test_head_status_outside_200_206_rejected(self):
+        """the pre-check accepts 200-206 only (source_http.go:105-124)."""
+        from aiohttp import web
+
+        async def origin(request):
+            if request.method == "HEAD":
+                return web.Response(status=403)
+            return web.Response(body=fixture_bytes("imaginary.jpg"),
+                                content_type="image/jpeg")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
+            assert res.status == 403
+
+        run(ServerOptions(enable_url_source=True, max_allowed_size=10_000_000),
+            fn, origin_handler=origin)
