@@ -65,7 +65,13 @@ def bench_ours(buf: bytes, n_threads: int, duration: float, reps: int = 1):
     from imaginary_tpu.options import ImageOptions
     from imaginary_tpu.ops.plan import choose_decode_shrink, plan_operation
 
-    executor = Executor(ExecutorConfig(window_ms=3.0, max_batch=16))
+    # BENCH_HOST_SPILL=off forces device-primary serving (the VERDICT's
+    # forced-device capture: every item must ride the chip, pricing the
+    # link honestly instead of routing around it); on/auto as the CLI
+    spill = {"auto": None, "on": True, "off": False}[
+        os.environ.get("BENCH_HOST_SPILL", "auto")]
+    executor = Executor(ExecutorConfig(window_ms=3.0, max_batch=16,
+                                       host_spill=spill))
     opts = ImageOptions(width=300, height=200)
 
     def one():

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Unattended TPU-capture watcher (round-5 successor of the r4 watchdog).
+#
+# The axon tunnel dies and revives unpredictably (memory: capture EARLY
+# while it works). This loop probes in a subprocess; the moment a real
+# TPU answers it captures the full round-5 artifact set in priority
+# order — forced-device first (VERDICT r4 next #1a), then the honest
+# auto headline, the latency harness against the pinned bars, and the
+# on-chip split + live link projection — then exits. The driver commits
+# uncommitted artifacts at round end, so a capture always lands.
+#
+# Launch:  nohup scripts/tpu_watch.sh > artifacts/tpu_watch.log 2>&1 &
+
+set -u
+cd "$(dirname "$0")/.."
+MARKER=artifacts/TPU_CAPTURE_r05_DONE
+PROBE='import subprocess, sys
+r = subprocess.run([sys.executable, "-c",
+                    "import jax; print([d.platform for d in jax.devices()])"],
+                   timeout=90, capture_output=True, text=True)
+ok = r.returncode == 0 and "tpu" in r.stdout
+print(r.stdout.strip(), file=sys.stderr)
+sys.exit(0 if ok else 1)'
+
+for i in $(seq 1 72); do   # up to ~12 h at 10 min per cycle
+  if [ -e "$MARKER" ]; then echo "already captured"; exit 0; fi
+  echo "[watch] probe $i at $(date -u +%H:%M:%S)"
+  if python -c "$PROBE"; then
+    echo "[watch] TPU ALIVE — capturing"
+    # 1. forced-device headline: every item rides the chip
+    BENCH_HOST_SPILL=off BENCH_DURATION=10 BENCH_REPS=3 timeout 900 \
+      python bench.py > artifacts/bench_r05_tpu_forced_device.json \
+      2> artifacts/bench_r05_tpu_forced_device.log
+    # 2. honest auto headline (cost-model placement)
+    BENCH_DURATION=10 BENCH_REPS=3 timeout 900 \
+      python bench.py > artifacts/bench_r05_tpu.json \
+      2> artifacts/bench_r05_tpu.log
+    # 3. latency harness, pinned bars (post-fusion TPU recapture)
+    BENCH_SECS=12 BENCH_BASELINE_PIN=artifacts/baseline_pin_cpu.json timeout 1800 \
+      python bench_latency.py > artifacts/bench_latency_r05_tpu.jsonl \
+      2> artifacts/bench_latency_r05_tpu.log
+    # 4. on-chip splits + LIVE link projection
+    timeout 1800 python bench_device.py \
+      > artifacts/bench_device_r05_tpu.jsonl \
+      2> artifacts/bench_device_r05_tpu.log
+    date -u > "$MARKER"
+    echo "[watch] capture complete"
+    exit 0
+  fi
+  sleep 510   # ~10 min per cycle including the 90 s probe
+done
+echo "[watch] tunnel never revived"
+exit 1
