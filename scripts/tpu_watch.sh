@@ -15,9 +15,13 @@ set -u
 cd "$(dirname "$0")/.."
 MARKER=artifacts/TPU_CAPTURE_r05_DONE
 PROBE='import subprocess, sys
-r = subprocess.run([sys.executable, "-c",
-                    "import jax; print([d.platform for d in jax.devices()])"],
-                   timeout=90, capture_output=True, text=True)
+try:
+    r = subprocess.run([sys.executable, "-c",
+                        "import jax; print([d.platform for d in jax.devices()])"],
+                       timeout=90, capture_output=True, text=True)
+except subprocess.TimeoutExpired:
+    print("probe hung (tunnel dead)", file=sys.stderr)
+    sys.exit(1)
 ok = r.returncode == 0 and "tpu" in r.stdout
 print(r.stdout.strip(), file=sys.stderr)
 sys.exit(0 if ok else 1)'
