@@ -1,18 +1,31 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench serve clean gate
+.PHONY: all native test bench serve clean gate lint
 
 all: native test
 
 # No-red-snapshot gate (VERDICT r2 next #1): run before ANY commit meant
-# to be a round snapshot. Green means: full suite passes, the driver's
-# entry + 8-device dryrun execute, and bench.py emits its JSON line
-# (CPU fallback allowed — the gate checks the machinery, not the chip).
-gate: test
+# to be a round snapshot. Green means: lint is clean, full suite passes,
+# the driver's entry + 8-device dryrun execute, and bench.py emits its
+# JSON line (CPU fallback allowed — the gate checks the machinery, not
+# the chip).
+gate: lint test
 	python __graft_entry__.py
 	BENCH_DURATION=2 BENCH_THREADS=8 python bench.py || \
 	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
 	@echo "GATE GREEN: tests + dryrun + bench all pass"
+
+# correctness-class lint (ruff.toml). FAILS the gate when ruff finds an
+# issue; hosts without ruff installed skip with a notice (the bench
+# containers don't ship it — CI images should).
+lint:
+	@if python -m ruff --version >/dev/null 2>&1; then \
+	  python -m ruff check .; \
+	elif command -v ruff >/dev/null 2>&1; then \
+	  ruff check .; \
+	else \
+	  echo "lint: ruff unavailable on this host - SKIPPED (pip install ruff to enable)"; \
+	fi
 
 native:
 	python -m imaginary_tpu.native.build
@@ -34,4 +47,5 @@ serve:
 
 clean:
 	rm -f imaginary_tpu/native/_imaginary_codecs*.so
+	rm -f imaginary_tpu/native/_imaginary_resample*.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -156,32 +156,11 @@ def main():
     # readback cost; 4 clients can never form more than a batch of 4)
     n_threads = int(os.environ.get("BENCH_THREADS", str(max(32, 4 * cpus))))
 
-    # build the native codec extension if missing (gitignored artifact)
-    import subprocess
+    # build the native extension if missing/stale (gitignored artifact);
+    # falls back to the resample-only module on codec-header-less hosts
+    from bench_util import ensure_native_built
 
-    import sysconfig
-
-    root = os.path.dirname(os.path.abspath(__file__))
-    src = os.path.join(root, "imaginary_tpu", "native", "codecs.cpp")
-    # THIS interpreter's extension filename (a leftover .so from another
-    # Python version must not satisfy the check)
-    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so = os.path.join(root, "imaginary_tpu", "native", "_imaginary_codecs" + suffix)
-    # rebuild on a MISSING or STALE extension: an old-ABI .so would make
-    # native_backend report unavailable and silently demote the bench to
-    # the cv2 codec backend; a missing codecs.cpp (deployed artifact)
-    # keeps whatever .so is present
-    stale = os.path.exists(src) and (
-        not os.path.exists(so) or os.path.getmtime(src) > os.path.getmtime(so))
-    if stale:
-        try:
-            r = subprocess.run([sys.executable, "-m", "imaginary_tpu.native.build"],
-                               timeout=180, capture_output=True, cwd=root)
-            if r.returncode != 0:
-                print(f"[bench] native build failed ({r.returncode}); using fallback codecs",
-                      file=sys.stderr)
-        except Exception as e:
-            print(f"[bench] native build error: {e}; using fallback codecs", file=sys.stderr)
+    ensure_native_built()
 
     platform = os.environ.get("BENCH_PLATFORM", "")
     fallback = False
@@ -217,9 +196,14 @@ def main():
     print(f"[bench] device-path items={exec_stats['items']} "
           f"spilled-to-host={exec_stats['spilled']}", file=sys.stderr)
     for name, s in stages.items():
+        # host_spill's p99/p50 ratio is the spill path's TAIL HEALTH: a
+        # ratio in the hundreds means placement is convoying items onto a
+        # saturated host pool (the r5 signature: p50 1.16 ms, p99 344.85 ms)
+        tail = (f" p99/p50={s['p99_ms'] / max(s['p50_ms'], 1e-3):.1f}x"
+                if name == "host_spill" else "")
         print(f"[bench]   stage {name:<12} n={s['count']:<6} "
               f"mean={s['mean_ms']:.2f}ms p50={s['p50_ms']:.2f}ms "
-              f"p99={s['p99_ms']:.2f}ms", file=sys.stderr)
+              f"p99={s['p99_ms']:.2f}ms{tail}", file=sys.stderr)
 
     base, base_reps = bench_baseline(buf, n_threads, duration, reps)
     print(f"[bench] cpu baseline (cv2): {base:.2f} req/s "

@@ -58,6 +58,10 @@ def main() -> None:
     import cv2
     import jax
 
+    from bench_util import ensure_native_built
+
+    ensure_native_built()
+
     from imaginary_tpu import codecs
     from imaginary_tpu.codecs import EncodeOptions
     from imaginary_tpu.engine import Executor, ExecutorConfig
@@ -95,6 +99,21 @@ def main() -> None:
     ours["host_fixed_ms"] = round(
         ours["probe_ms"] + ours["decode_ms"] + ours["encode_ms"], 3)
 
+    # host-path /enlarge decomposition (the r5 FAIL row): 1080p full decode
+    # -> 2560x1440 separable upsample on the spill interpreter -> encode.
+    # The transform is the fix's target; decode/encode bound what any
+    # resampler could achieve on this host.
+    d_full = codecs.decode(buf, 1)
+    eopts = ImageOptions(width=2560, height=1440)
+    eplan = plan_operation("enlarge", eopts, d_full.array.shape[0],
+                           d_full.array.shape[1], d_full.orientation,
+                           d_full.array.shape[2])
+    big = host_exec.run(d_full.array, eplan)
+    ours["transform_host_enlarge_ms"] = _median_ms(
+        lambda: host_exec.run(d_full.array, eplan), n=20)
+    ours["encode_enlarge_ms"] = _median_ms(
+        lambda: codecs.encode(big, EncodeOptions(type=ImageType.JPEG)), n=20)
+
     # ---- cv2 baseline stages (same work split) ---------------------------
     data = np.frombuffer(buf, np.uint8)
     a = cv2.imdecode(data, cv2.IMREAD_COLOR)
@@ -107,6 +126,11 @@ def main() -> None:
         "encode_ms": _median_ms(lambda: cv2.imencode(".jpg", r, jq)),
     }
     base["total_ms"] = round(sum(base.values()), 3)
+    # the cv2 equivalent of the enlarge transform (bicubic, the latency
+    # bench's baseline op) — NOT in total_ms, which grades the resize row
+    base["enlarge_transform_ms"] = _median_ms(
+        lambda: cv2.resize(a, (2560, 1440), interpolation=cv2.INTER_CUBIC),
+        n=20)
 
     # ---- ceiling math ----------------------------------------------------
     # On a 1-CPU host, serial rates bound single-process throughput. The

@@ -87,6 +87,41 @@ def run_workers(call, duration: float, n_threads: int):
     return sum(counts) / elapsed, [x for sub in lats for x in sub]
 
 
+def ensure_native_built(timeout: float = 180.0) -> None:
+    """Build the best available native module (full codecs, else the
+    dependency-free resample-only build) when missing or stale, so a bench
+    run measures the native spill-path resize rather than the numpy
+    fallback. Failures are non-fatal: the python paths serve, just slower,
+    and the run's own stderr makes the difference visible."""
+    import os
+    import subprocess
+    import sys
+    import sysconfig
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(root, "imaginary_tpu", "native", "codecs.cpp")
+    if not os.path.exists(src):  # deployed artifact: keep whatever .so exists
+        return
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    native_dir = os.path.join(root, "imaginary_tpu", "native")
+    sos = [os.path.join(native_dir, name + suffix)
+           for name in ("_imaginary_codecs", "_imaginary_resample")]
+    src_mtime = os.path.getmtime(src)
+    fresh = [so for so in sos
+             if os.path.exists(so) and os.path.getmtime(so) >= src_mtime]
+    if fresh:
+        return
+    try:
+        r = subprocess.run([sys.executable, "-m", "imaginary_tpu.native.build"],
+                           timeout=timeout, capture_output=True, cwd=root)
+        if r.returncode != 0:
+            print(f"[bench] native build failed ({r.returncode}); "
+                  "python fallbacks serve", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] native build error: {e}; python fallbacks serve",
+              file=sys.stderr)
+
+
 def free_port() -> int:
     """Ephemeral TCP port (shared by bench harnesses and tests)."""
     import socket

@@ -139,6 +139,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(auto = enabled, governed by the measured cost "
                         "model; spilled responses carry "
                         "X-Imaginary-Backend: host)")
+    p.add_argument("--force-host", action="store_true",
+                   help="pin every host-executable plan to the host SIMD "
+                        "interpreter (measurement override; device-only "
+                        "plans still ride the chip)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
@@ -225,6 +229,7 @@ def options_from_args(args) -> ServerOptions:
         spatial=max(1, args.spatial),
         spatial_threshold_px=max(1, args.spatial_threshold_px),
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
+        force_host=args.force_host,
         prewarm=args.prewarm,
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,

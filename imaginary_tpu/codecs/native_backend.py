@@ -6,6 +6,11 @@ quantizer, all with the GIL released). Every DECODABLE/ENCODABLE raster
 format runs natively (SURVEY.md section 2.12: no Python stand-ins on the
 pixel path); PIL appears only in probe(), where its header-only open
 carries richer /info metadata (ICC/space) than the C parsers report.
+
+Partial builds (native/build.py -DITPU_NO_WEBP, for hosts missing only
+libwebp-dev) export FORMATS; formats absent from the build route to the
+cv2/PIL backend per call, so a partial native build is strictly faster
+than no native build, never less capable.
 """
 
 from __future__ import annotations
@@ -22,17 +27,82 @@ try:
 except ImportError:  # pragma: no cover - extension not built
     _ext = None
 
+# Resample-only fallback module (build.py -DITPU_RESAMPLE_ONLY): hosts
+# without the codec dev headers still get the native spill-path resize.
+try:
+    from imaginary_tpu.native import _imaginary_resample as _rext
+except ImportError:
+    _rext = None
+
 
 def available() -> bool:
     return _ext is not None and getattr(_ext, "ABI", 0) >= 3
 
 
-_NATIVE_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP,
-                 ImageType.GIF, ImageType.TIFF}
+def _resample_ext():
+    if _ext is not None and hasattr(_ext, "resize_separable"):
+        return _ext
+    if _rext is not None and hasattr(_rext, "resize_separable"):
+        return _rext
+    return None
+
+
+def resample_available() -> bool:
+    """True when SOME native module carries resize_separable (the full
+    codec extension or the dependency-free resample-only build)."""
+    return _resample_ext() is not None
+
+
+def resize_separable(arr: np.ndarray, dst_h: int, dst_w: int,
+                     kernel: str) -> np.ndarray:
+    """Separable precomputed-tap resize of an HWC uint8 array, GIL
+    released. Kernel semantics match the device sampling matrix
+    (ops/stages.sample_matrix): per-axis stretch, edge-clamp
+    renormalization, round-half-up to uint8."""
+    ext = _resample_ext()
+    if ext is None:
+        raise CodecError("native resampler not built", 500)
+    h, w, c = arr.shape
+    out = ext.resize_separable(np.ascontiguousarray(arr), h, w, c,
+                               dst_h, dst_w, kernel)
+    return np.frombuffer(out, dtype=np.uint8).reshape(dst_h, dst_w, c)
+
+
+_ALL_RASTER_TYPES = {ImageType.JPEG, ImageType.PNG, ImageType.WEBP,
+                     ImageType.GIF, ImageType.TIFF}
+
+
+def _supported_types():
+    """Formats THIS build carries. Full builds export all five; a
+    -DITPU_NO_WEBP build reports itself via FORMATS and the absent
+    format routes to the cv2/PIL fallback per call."""
+    if _ext is None:
+        return set()
+    fmts = getattr(_ext, "FORMATS", None)
+    if not fmts:  # pre-FORMATS full build
+        return set(_ALL_RASTER_TYPES)
+    names = set(fmts.split(","))
+    return {t for t in _ALL_RASTER_TYPES if t.value in names}
+
+
+_NATIVE_TYPES = _supported_types()
+
+
+def _fallback_backend():
+    try:
+        from imaginary_tpu.codecs import cv2_backend
+
+        return cv2_backend
+    except Exception:  # pragma: no cover - cv2 not installed
+        from imaginary_tpu.codecs import pil_backend
+
+        return pil_backend
 
 
 def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
     if t not in _NATIVE_TYPES:
+        if t in _ALL_RASTER_TYPES:  # absent from this PARTIAL build only
+            return _fallback_backend().decode(buf, t, shrink)
         raise CodecError(f"Cannot decode image: unsupported format {t.value}", 400)
     denom = shrink if (t is ImageType.JPEG and shrink in (2, 4, 8)) else 1
     try:
@@ -47,6 +117,8 @@ def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
 def encode(arr: np.ndarray, opts: EncodeOptions) -> bytes:
     t = opts.type
     if t not in _NATIVE_TYPES:
+        if t in _ALL_RASTER_TYPES:  # absent from this PARTIAL build only
+            return _fallback_backend().encode(arr, opts)
         raise CodecError(f"Cannot encode image: unsupported format {t.value}", 400)
     arr = np.ascontiguousarray(arr)
     h, w, c = arr.shape

@@ -164,12 +164,21 @@ class _Lexer:
         return bytes(out)
 
 
+# Decompressed-stream budget: the 64 MB request-body cap bounds what a
+# client can SEND, not what a few KB of crafted deflate can EXPAND to
+# (zlib tops out around 1000:1, so a 64 MB body could otherwise demand
+# ~64 GB). 64 MB of decompressed content is far beyond any honest page's
+# content stream in this renderer's subset.
+_MAX_STREAM_BYTES = 64 * 1024 * 1024
+
+
 class _Doc:
     def __init__(self, data: bytes):
         self.d = data
         self.offsets: dict = {}
         self.trailer: dict = {}
         self._cache: dict = {}
+        self._resolving: set = set()
         self._parse_xref()
 
     def _parse_xref(self):
@@ -219,25 +228,34 @@ class _Doc:
             return ref
         if ref.num in self._cache:
             return self._cache[ref.num]
+        # A /Length (or /Filter) that resolves back into its own object —
+        # directly or through a cycle — would recurse here forever; a real
+        # renderer refuses such a file, it doesn't RecursionError.
+        if ref.num in self._resolving:
+            raise UnsupportedPdf("circular reference")
         off = self.offsets.get(ref.num)
         if off is None:
             raise UnsupportedPdf(f"missing object {ref.num}")
         m = re.match(rb"\s*\d+\s+\d+\s+obj", self.d[off : off + 64])
         if not m:
             raise UnsupportedPdf(f"bad object header at {off}")
-        lex = _Lexer(self.d, off + m.end())
-        val = lex.parse()
-        if isinstance(val, dict):
-            lex._skip_ws()
-            if self.d[lex.p : lex.p + 6] == b"stream":
-                p = lex.p + 6
-                if self.d[p : p + 2] == b"\r\n":
-                    p += 2
-                elif self.d[p : p + 1] in (b"\n", b"\r"):
-                    p += 1
-                length = self.obj(val.get("/Length", 0))
-                raw = self.d[p : p + int(length)]
-                val = (val, raw)
+        self._resolving.add(ref.num)
+        try:
+            lex = _Lexer(self.d, off + m.end())
+            val = lex.parse()
+            if isinstance(val, dict):
+                lex._skip_ws()
+                if self.d[lex.p : lex.p + 6] == b"stream":
+                    p = lex.p + 6
+                    if self.d[p : p + 2] == b"\r\n":
+                        p += 2
+                    elif self.d[p : p + 1] in (b"\n", b"\r"):
+                        p += 1
+                    length = self.obj(val.get("/Length", 0))
+                    raw = self.d[p : p + int(length)]
+                    val = (val, raw)
+        finally:
+            self._resolving.discard(ref.num)
         self._cache[ref.num] = val
         return val
 
@@ -250,10 +268,35 @@ class _Doc:
         for f in filters:
             f = self.obj(f)
             if f == "/FlateDecode":
-                raw = zlib.decompress(raw)
+                raw = _bounded_inflate(raw)
             else:
                 raise UnsupportedPdf(f"filter {f} not supported")
         return raw
+
+
+def _bounded_inflate(raw: bytes, budget: int = 0) -> bytes:
+    """zlib.decompress with an output cap: inflate in max_length chunks and
+    refuse past the budget, so a decompression bomb costs at most the
+    budget in memory instead of whatever the deflate stream demands."""
+    budget = budget or _MAX_STREAM_BYTES
+    dec = zlib.decompressobj()
+    out = []
+    got = 0
+    data = raw
+    while True:
+        chunk = dec.decompress(data, max(1, min(budget - got + 1, 1 << 20)))
+        got += len(chunk)
+        if got > budget:
+            raise UnsupportedPdf("stream exceeds decompression budget")
+        out.append(chunk)
+        data = dec.unconsumed_tail
+        if dec.eof:
+            break
+        if not data and not chunk:
+            # input exhausted short of the stream end: the strict
+            # zlib.decompress this replaces raised on truncation too
+            raise UnsupportedPdf("truncated deflate stream")
+    return b"".join(out)
 
 
 def _mat_mul(m1, m2):

@@ -86,6 +86,12 @@ class ExecutorConfig:
     # fast PCIe/ICI link device_ms_per_mb is microseconds so nothing ever
     # spills. "off" remains an explicit operator override.
     host_spill: Optional[bool] = None
+    # Route every host-executable plan to the host interpreter regardless
+    # of the cost model (device-only plans still ride the chip). This is a
+    # MEASUREMENT override, not a serving policy: bench_latency.py's
+    # host-path rows pin placement so a run prices the spill interpreter
+    # itself, not whatever mix the cost model happened to choose.
+    force_host: bool = False
     spill_factor: float = 6.0
     probe_interval: int = 64
     # Wall-clock backstop on the count gate: at 20 rps, every-64th fires a
@@ -152,8 +158,14 @@ class ExecutorStats:
     shadow_probes: int = 0  # discarded device rides that refresh the cost model
     device_ms_per_mb: float = 0.0  # measured drain cost per wire megabyte
     host_ms_per_mpix: float = 0.0  # measured host CPU cost per megapixel
+    host_inflight: int = 0  # spilled items executing on host threads right now
+    host_owed_mpix: float = 0.0  # megapixels of in-flight host work (the pool's backlog)
 
     def to_dict(self) -> dict:
+        # per-stage spill timing rides along so the p99 tail is
+        # attributable from /health alone (the admission gate and the
+        # bench both read this dict)
+        spill_times = TIMES.snapshot().get("host_spill")
         return {
             "items": self.items,
             "batches": self.batches,
@@ -172,6 +184,10 @@ class ExecutorStats:
             "shadow_probes": self.shadow_probes,
             "device_ms_per_mb": round(self.device_ms_per_mb, 3),
             "host_ms_per_mpix": round(self.host_ms_per_mpix, 3),
+            "host_inflight": self.host_inflight,
+            "host_owed_mpix": round(self.host_owed_mpix, 3),
+            "host_spill_p50_ms": spill_times["p50_ms"] if spill_times else 0.0,
+            "host_spill_p99_ms": spill_times["p99_ms"] if spill_times else 0.0,
         }
 
 
@@ -334,6 +350,44 @@ class Executor:
         if _LINK_SEED is not None and _LINK_SEED[1] > 0.0:
             self._drain_floor_ms = _LINK_SEED[1]
         self._host_ms_per_mpix: float = 15.0  # EWMA, bootstrap (~2 ms / 0.13 Mpix)
+        # Host-pool occupancy ledger, the mirror of _owed_ms for the OTHER
+        # placement target: megapixels of spilled work currently executing
+        # on host threads. Charged when a spill starts, released when it
+        # finishes; _should_spill divides by the CPU count to estimate the
+        # queueing delay one more spill would actually see. Without it the
+        # comparison priced the host at its UNLOADED marginal cost, so
+        # once the device looked slow every arrival spilled at once and
+        # convoyed onto a saturated pool — measured as host_spill p50
+        # 1.16 ms / p99 344.85 ms (r5 bench, 32 threads on 1 CPU).
+        self._host_owed_mpix = 0.0
+        self._host_inflight = 0
+        self._ncpus = _available_cpus()
+        # None = not yet probed. On the cpu-jax fallback backend the
+        # "device" runs on the host's own cores, so host-pool backlog
+        # delays BOTH placement targets and must cancel out of the spill
+        # comparison; only a real accelerator is independent silicon that
+        # a saturated host can usefully divert to.
+        self._device_shares_cpu: Optional[bool] = None
+        # Bounded spill concurrency: more simultaneous interpreter runs
+        # than cores buys nothing but context-switch thrash — under the
+        # 32-thread closed-loop bench on 1 CPU, unbounded admission put
+        # the whole queueing delay INSIDE each run's wall clock (host_spill
+        # p50 0.91 ms vs p99 307 ms, a 338x tail). With a small gate the
+        # wait happens up front (timed as host_gate), each admitted run
+        # finishes at its own pace, and the occupancy ledger sees honest
+        # numbers. One permit per core: the gated region is pure
+        # GIL-released CPU work, so extra admissions only processor-share
+        # the cores and stretch every overlapped run (A-B on the 1-CPU
+        # bench host: 1 permit vs 2 cut request p99 61 -> 58 ms and the
+        # host_spill stage p99 97 -> 46 ms at the same offered rate).
+        # IMAGINARY_TPU_HOST_GATE overrides the permit count (operator
+        # escape hatch / A-B measurement knob).
+        import os as _os
+
+        permits = int(_os.environ.get("IMAGINARY_TPU_HOST_GATE", "0") or 0)
+        if permits <= 0:
+            permits = max(1, self._ncpus)
+        self._host_gate = threading.BoundedSemaphore(permits)
         self._spill_seen = 0
         self._probe_slots_skipped = 0
         # "never": the first probe slot is free — a fresh executor's rates
@@ -403,8 +457,17 @@ class Executor:
                 _PLACEMENT.value = "host"
                 item.future.set_result(out)
                 return item.future
-        if self.config.host_spill and self._should_spill(item):
+        forced = self.config.force_host and host_exec.can_execute(
+            plan, for_spill=False)
+        if forced or (self.config.host_spill and self._should_spill(item)):
+            # charge BEFORE the gate: a waiter is backlog, and the
+            # occupancy term in _should_spill must see it so follow-up
+            # arrivals divert to the device instead of joining the convoy
+            self._host_charge(item.mpix)
+            tg = time.monotonic()
+            self._host_gate.acquire()
             t0 = time.monotonic()
+            TIMES.record("host_gate", (t0 - tg) * 1000.0)
             c0 = time.thread_time()
             try:
                 out = host_exec.run(arr, plan)
@@ -434,9 +497,26 @@ class Executor:
                 _PLACEMENT.value = "host"
                 item.future.set_result(out)
                 return item.future
+            finally:
+                self._host_release(item.mpix)
+                self._host_gate.release()
         self._charge_owed(item)
         self._queue.put(item)
         return item.future
+
+    def _host_charge(self, mpix: float) -> None:
+        with self._owed_lock:
+            self._host_inflight += 1
+            self._host_owed_mpix += mpix
+            self.stats.host_inflight = self._host_inflight
+            self.stats.host_owed_mpix = self._host_owed_mpix
+
+    def _host_release(self, mpix: float) -> None:
+        with self._owed_lock:
+            self._host_inflight -= 1
+            self._host_owed_mpix = max(0.0, self._host_owed_mpix - mpix)
+            self.stats.host_inflight = self._host_inflight
+            self.stats.host_owed_mpix = self._host_owed_mpix
 
     def _charge_owed(self, item: "_Item") -> None:
         """Book the item's estimated device milliseconds against the queue;
@@ -493,6 +573,7 @@ class Executor:
         with self._owed_lock:
             owed_ms = self._owed_ms
             host_rate = self._host_ms_per_mpix
+            host_owed_mpix = self._host_owed_mpix
         # The floor term is load-bearing for the LATENCY tail: every drain
         # pays the link's fixed round-trip (~65 ms on the tunneled bench
         # link) on top of bytes x rate, and an item deciding placement
@@ -503,8 +584,34 @@ class Executor:
         # their 300-477 ms drains set the route's p99, the rate rises,
         # spill resumes, repeat (~6 s period on the r4 latency bench).
         wait_ms = owed_ms + (self._drain_floor_ms or 0.0) + item.wire_mb * dev_rate
+        # The host side of the comparison is symmetric with the device's:
+        # service cost PLUS the queueing delay behind work already placed
+        # there. host_owed_mpix / ncpus is the expected wait for a core —
+        # spills run inline on caller threads, so occupancy beyond the CPU
+        # count is pure queueing. Pricing the host at its unloaded marginal
+        # cost convoyed every arrival onto a saturated pool the moment the
+        # device looked slow (r5: host_spill p50 1.16 ms vs p99 344.85 ms).
+        # The spill_factor margin biases only the SERVICE comparison —
+        # queue terms sit outside it on both sides. Folding the queue into
+        # the 6x margin made a merely-busy host look 6x worse than it is,
+        # and the closed-loop saturation bench diverted 233 items onto the
+        # cpu-fallback "device" (same core + JAX overhead): 189 req/s vs
+        # 236 with the queue term outside the factor.
+        # On cpu-fallback the backlog delays both targets equally (same
+        # silicon), so the term cancels: without this, saturation benches
+        # equilibrate with a standing device queue that steals the very
+        # CPU the host pool needs.
+        if self._device_shares_cpu is None:
+            try:
+                import jax
+
+                self._device_shares_cpu = jax.default_backend() == "cpu"
+            except Exception:  # pragma: no cover - jax import failure
+                self._device_shares_cpu = False
+        host_queue_ms = (0.0 if self._device_shares_cpu
+                         else host_owed_mpix / self._ncpus * host_rate)
         host_ms = max(item.mpix, 1e-3) * host_rate
-        if wait_ms <= self.config.spill_factor * host_ms:
+        if wait_ms <= self.config.spill_factor * host_ms + host_queue_ms:
             return False
         if not host_exec.can_execute(item.plan):
             return False

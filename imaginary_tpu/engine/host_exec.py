@@ -21,6 +21,7 @@ difference as libvips kernel selection vs other backends.
 
 from __future__ import annotations
 
+import functools
 
 import numpy as np
 
@@ -214,14 +215,6 @@ def _rgb_to_i420(x: np.ndarray):
 
 # --- per-spec interpreters ----------------------------------------------------
 
-_CV2_KERNELS = {
-    "nearest": 0,  # cv2.INTER_NEAREST
-    "linear": 1,  # cv2.INTER_LINEAR
-    "cubic": 2,  # cv2.INTER_CUBIC
-    "lanczos2": 4,  # cv2.INTER_LANCZOS4 (closest available)
-    "lanczos3": 4,
-}
-
 
 def _apply(spec, x, dyn):
     if isinstance(spec, SampleSpec):
@@ -230,22 +223,30 @@ def _apply(spec, x, dyn):
             return x
         shrink_h = dh < x.shape[0]
         shrink_w = dw < x.shape[1]
-        if _HAS_CV2 and (spec.kernel == "nearest" or shrink_h == shrink_w):
+        if _HAS_CV2 and (spec.kernel == "nearest" or (shrink_h and shrink_w)):
             if spec.kernel == "nearest":
                 interp = cv2.INTER_NEAREST
-            elif shrink_h and shrink_w:
+            else:
                 # minification: area averaging is the host analogue of the
                 # device's stretched-kernel (antialiased) resample
                 interp = cv2.INTER_AREA
-            else:
-                interp = _CV2_KERNELS.get(spec.kernel, cv2.INTER_LANCZOS4)
             out = cv2.resize(x, (dw, dh), interpolation=interp)
             if out.ndim == 2:  # cv2 drops a trailing singleton channel
                 out = out[:, :, None]
             return out
-        # Mixed shrink/enlarge (exactly one axis minified): cv2 offers no
-        # per-axis antialiasing, so use the exact stretched-kernel port —
-        # the device path antialiases each axis independently.
+        # Mixed shrink/enlarge and pure-enlarge: separable two-pass resample
+        # with precomputed per-axis taps — the device's sampling-matrix
+        # scheme, so each axis antialiases independently and the kernel
+        # matches the device's (cv2 has neither: no per-axis antialiasing,
+        # and its LANCZOS4 is an 8-tap kernel the device never runs; its
+        # enlarge path measured 75 ms vs 46 ms native lanczos3 on 1080p ->
+        # 1440p). Native SIMD when the extension is built, vectorized
+        # numpy taps otherwise — never the dense stretched-kernel matmul
+        # (measured 59 SECONDS on that same enlarge).
+        if x.dtype == np.uint8:
+            out = _native_resize(x, dh, dw, spec.kernel)
+            if out is not None:
+                return out
         return _np_resize(x, dh, dw, spec.kernel)
 
     if isinstance(spec, ExtractSpec):
@@ -344,13 +345,102 @@ def _composite(spec, x, dyn):
     return rgb
 
 
+# Native separable resampler: resolved on first use (the codecs package
+# imports lazily everywhere in this module — same cycle-avoidance idiom).
+# None = not yet probed, False = unavailable, else the binding callable.
+_NATIVE_RESAMPLE = None
+
+
+def _native_resize(x, dh, dw, kernel):
+    """Native separable resize of an HWC uint8 array, or None when the
+    extension (full codecs or the resample-only build) isn't present."""
+    global _NATIVE_RESAMPLE
+    if _NATIVE_RESAMPLE is None:
+        try:
+            from imaginary_tpu.codecs import native_backend
+
+            _NATIVE_RESAMPLE = (
+                native_backend.resize_separable
+                if native_backend.resample_available() else False
+            )
+        except Exception:  # pragma: no cover - codecs package unimportable
+            _NATIVE_RESAMPLE = False
+    if not _NATIVE_RESAMPLE:
+        return None
+    try:
+        return _NATIVE_RESAMPLE(x, dh, dw, kernel)
+    except Exception:
+        return None  # numpy taps serve; a native edge case must not 500
+
+
 def _np_resize(x, dh, dw, kernel):
-    """Exact port of the device's sampling-matrix resample (numpy fallback)."""
+    """Separable precomputed-tap port of the device's sampling-matrix
+    resample. Same weights as the device (per-axis stretch, edge-clamp
+    renormalization) but evaluated over each output coordinate's ~2*radius*
+    stretch contiguous taps instead of a dense [out, in] matmul — the
+    dense port measured 59 s on a 1080p->1440p lanczos3; this runs it in
+    tens of ms and the taps amortize across calls via _tap_table's LRU."""
     f = x.astype(np.float32)
-    wy = _np_sample_matrix(dh, f.shape[0], kernel)
-    wx = _np_sample_matrix(dw, f.shape[1], kernel)
-    t = np.einsum("yk,kwc->ywc", wy, f)
-    return np.einsum("xw,ywc->yxc", wx, t)
+    if dh != f.shape[0]:
+        f = _resize_axis(f, dh, kernel, 0)
+    if dw != f.shape[1]:
+        f = _resize_axis(f, dw, kernel, 1)
+    return f
+
+
+_KERNEL_RADIUS = {"lanczos3": 3.0, "lanczos2": 2.0, "cubic": 2.0,
+                  "linear": 1.0, "nearest": 0.5}
+
+
+@functools.lru_cache(maxsize=128)
+def _tap_table(out_n, in_n, kind):
+    """(idx [out_n, taps] int64, wts [out_n, taps] f32) for one axis.
+
+    Row y's taps cover the contiguous integer window around centre =
+    (y+0.5)/scale - 0.5 within the stretched kernel's support; taps
+    falling outside the source get zero weight and the row renormalizes
+    over the rest (the sample_matrix edge-clamp scheme). Indices are
+    clipped so gathers stay in-bounds. Keyed per (src, dst, kernel) —
+    a small LRU because serving traffic concentrates on few geometries."""
+    scale = out_n / in_n
+    stretch = max(1.0, 1.0 / scale)
+    support = _KERNEL_RADIUS.get(kind, 1.0) * stretch
+    ntaps = int(np.ceil(2.0 * support)) + 1
+    centre = (np.arange(out_n, dtype=np.float64) + 0.5) / scale - 0.5
+    k0 = np.floor(centre - support).astype(np.int64) + 1
+    idx = k0[:, None] + np.arange(ntaps)[None, :]
+    d = ((idx - centre[:, None]) / stretch).astype(np.float32)
+    wts = np.asarray(_np_kernel(kind, d), dtype=np.float32)
+    wts = np.where((idx >= 0) & (idx < in_n), wts, np.float32(0.0))
+    norm = wts.sum(axis=1, keepdims=True)
+    wts = np.where(norm > 1e-6, wts / np.maximum(norm, 1e-6),
+                   np.float32(0.0)).astype(np.float32)
+    idx = np.clip(idx, 0, in_n - 1)
+    idx.setflags(write=False)
+    wts.setflags(write=False)
+    return idx, wts
+
+
+def _resize_axis(f, out_n, kind, axis):
+    """One separable pass: gather + weighted-sum over the tap window,
+    vectorized across the other axis and channels (a python loop only
+    over the handful of taps)."""
+    idx, wts = _tap_table(out_n, f.shape[axis], kind)
+    out = None
+    for t in range(wts.shape[1]):
+        w = wts[:, t]
+        if not w.any():
+            continue
+        if axis == 0:
+            term = w[:, None, None] * f[idx[:, t]]
+        else:
+            term = w[None, :, None] * f[:, idx[:, t]]
+        out = term if out is None else out + term
+    if out is None:  # degenerate: all-zero rows (cannot happen for n>=1)
+        shape = list(f.shape)
+        shape[axis] = out_n
+        out = np.zeros(shape, np.float32)
+    return out
 
 
 def _np_kernel(kind, d):
@@ -366,17 +456,6 @@ def _np_kernel(kind, d):
     if kind == "linear":
         return np.maximum(0.0, 1.0 - ad)
     return np.where((d >= -0.5) & (d < 0.5), 1.0, 0.0)  # nearest
-
-
-def _np_sample_matrix(out_n, in_n, kind):
-    y = np.arange(out_n, dtype=np.float32)[:, None]
-    k = np.arange(in_n, dtype=np.float32)[None, :]
-    scale = out_n / in_n
-    centre = (y + 0.5) / scale - 0.5
-    stretch = max(1.0, 1.0 / scale)
-    wts = _np_kernel(kind, (k - centre) / stretch)
-    norm = wts.sum(axis=-1, keepdims=True)
-    return np.where(norm > 1e-6, wts / np.maximum(norm, 1e-6), 0.0)
 
 
 def _np_blur(x, radius, sigma):

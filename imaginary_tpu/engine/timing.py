@@ -27,6 +27,7 @@ STAGES = (
     "drain",        # fetch start -> host bytes landed (one sync, amortized/item)
     "device_wait",  # split mode only: fetch start -> outputs ready (H2D + compute)
     "d2h",          # split mode only: device->host readback (amortized/item)
+    "host_gate",    # wait for a host-pool slot (bounded spill concurrency)
     "host_spill",   # host SIMD interpreter execution (spilled items)
     "encode",       # host codec encode
     "total",        # whole processing call

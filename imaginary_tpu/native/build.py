@@ -4,6 +4,14 @@ Usage: python -m imaginary_tpu.native.build  (or `make native`).
 Compiles codecs.cpp against system libjpeg/libpng/libwebp into
 imaginary_tpu/native/_imaginary_codecs.*.so; codecs/native_backend.py picks
 it up on next interpreter start.
+
+Hosts missing the codec dev headers (libwebp-dev is the usual gap) still
+get the native SPILL-PATH resampler: build_resample() compiles the same
+source with -DITPU_RESAMPLE_ONLY into _imaginary_resample.*.so — no
+external libraries at all, just a C++ toolchain. `python -m
+imaginary_tpu.native.build` tries the full module first and falls back to
+the resample-only one, so `make native` always leaves the fastest
+available host resize behind.
 """
 
 from __future__ import annotations
@@ -15,27 +23,65 @@ import sysconfig
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+# -O3: the separable resampler's tap loops vectorize only at this level
+# (measured 135 -> 46 ms on a 1080p->1440p lanczos3; the codecs just ride
+# along — their hot loops live inside libjpeg/libpng anyway).
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17"]
 
-def build(verbose: bool = True) -> str:
+
+def _compile(out_name: str, extra: list, verbose: bool) -> str:
     src = os.path.join(HERE, "codecs.cpp")
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    out = os.path.join(HERE, "_imaginary_codecs" + suffix)
+    out = os.path.join(HERE, out_name + suffix)
     include = sysconfig.get_path("include")
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        f"-I{include}",
-        src, "-o", out,
-        "-ljpeg", "-lpng", "-lwebp", "-ltiff",
-    ]
+    cmd = ["g++", *_CXX_FLAGS, f"-I{include}", src, "-o", out, *extra]
     if verbose:
         print(" ".join(cmd))
     subprocess.run(cmd, check=True)
     return out
 
 
+def build(verbose: bool = True) -> str:
+    """Full codec module (needs libjpeg/libpng/libwebp headers; libtiff is
+    bound by hand against the runtime .so)."""
+    return _compile("_imaginary_codecs", ["-ljpeg", "-lpng", "-lwebp", "-ltiff"],
+                    verbose)
+
+
+def build_no_webp(verbose: bool = True) -> str:
+    """Codec module minus webp (libwebp-dev is the usual missing header;
+    the binding routes webp traffic to cv2/PIL on such hosts)."""
+    return _compile("_imaginary_codecs",
+                    ["-DITPU_NO_WEBP", "-ljpeg", "-lpng", "-ltiff"], verbose)
+
+
+def build_resample(verbose: bool = True) -> str:
+    """Dependency-free separable resampler (always buildable with g++)."""
+    return _compile("_imaginary_resample", ["-DITPU_RESAMPLE_ONLY"], verbose)
+
+
+def build_any(verbose: bool = True) -> str:
+    """Best available native module, most- to least-capable: full codecs,
+    codecs minus webp, else the resample-only module."""
+    try:
+        return build(verbose)
+    except Exception as e:
+        if verbose:
+            print(f"full codec build failed ({e}); trying no-webp codec "
+                  "build", file=sys.stderr)
+    try:
+        return build_no_webp(verbose)
+    except Exception as e:
+        if verbose:
+            print(f"no-webp codec build failed ({e}); building "
+                  "resample-only module", file=sys.stderr)
+        return build_resample(verbose)
+
+
 if __name__ == "__main__":
-    path = build()
+    path = build_any()
     sys.path.insert(0, HERE)
-    import _imaginary_codecs  # noqa: F401  (smoke import)
+    name = os.path.basename(path).split(".")[0]
+    __import__(name)  # smoke import
 
     print(f"built {path}")

@@ -75,6 +75,9 @@ class ServerOptions:
     # operators can detect mixed-backend traffic (/info and error responses
     # never touch the executor and carry no such header).
     host_spill: Optional[bool] = None
+    # Pin every host-executable plan to the host interpreter (measurement
+    # override for bench_latency's host-path rows; see ExecutorConfig).
+    force_host: bool = False
     prewarm: bool = False
     # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
     distributed: bool = False

@@ -78,6 +78,7 @@ class ImageService:
                 spatial=o.spatial,
                 spatial_threshold_px=o.spatial_threshold_px,
                 host_spill=o.host_spill,
+                force_host=o.force_host,
             )
         )
         from imaginary_tpu.engine.executor import _available_cpus
@@ -175,21 +176,29 @@ class ImageService:
                     raise
                 # probe failure falls through; decode will produce the error
 
-        loop = asyncio.get_running_loop()
         wm_rgba = await self._prefetch_watermark(request, op_name, opts)
-        # Inflight is incremented HERE (the pool task is now certain to
-        # run) and decremented inside _process_sync's own finally, in the
-        # pool thread — NOT in an async finally: a client disconnect
-        # cancels this coroutine while the worker thread keeps running,
-        # and decrementing on cancellation would collapse the backlog
-        # signal to ~0 exactly at overload (mass client timeouts), failing
-        # the admission gate open when it matters most.
+        # Inflight is incremented HERE and normally decremented inside
+        # _process_sync's own finally, in the pool thread — NOT in an
+        # async finally: a client disconnect cancels this coroutine while
+        # the worker thread keeps running, and decrementing on
+        # cancellation would collapse the backlog signal to ~0 exactly at
+        # overload (mass client timeouts), failing the admission gate
+        # open when it matters most. The one case _process_sync's finally
+        # can never cover: a task cancelled while still QUEUED in the
+        # pool never starts, so the done-callback balances the ledger for
+        # exactly the fut.cancelled() outcome (run_in_executor can't
+        # express this — its asyncio future abandons the pool task
+        # without cancelling it; submit + wrap_future propagates the
+        # cancellation into the pool queue). Without it every cancelled-
+        # while-queued request leaked one _inflight forever, inflating
+        # estimated_queue_ms until --max-queue-ms latched shut.
         with self._inflight_lock:
             self._inflight += 1
+        fut = self.pool.submit(self._process_sync, op_name, buf, opts,
+                               wm_rgba, meta)
+        fut.add_done_callback(self._release_if_cancelled)
         try:
-            out, placement = await loop.run_in_executor(
-                self.pool, self._process_sync, op_name, buf, opts, wm_rgba, meta
-            )
+            out, placement = await asyncio.wrap_future(fut)
         except ImageError:
             raise
         except Exception as e:
@@ -231,6 +240,15 @@ class ImageService:
             alpha = np.full(arr.shape[:2] + (1,), 255, dtype=np.uint8)
             arr = np.concatenate([arr, alpha], axis=2)
         return arr
+
+    def _release_if_cancelled(self, fut) -> None:
+        """Balance the _inflight ledger for pool tasks that never ran: a
+        future cancelled while queued skips _process_sync (and its
+        finally) entirely. Ran-and-finished futures are NOT cancelled, so
+        this never double-decrements."""
+        if fut.cancelled():
+            with self._inflight_lock:
+                self._inflight -= 1
 
     def _process_sync(self, op_name, buf, opts, wm_rgba, meta=None):
         # Service-time EWMA measured INSIDE the worker thread: stamping

@@ -18,9 +18,12 @@ def native():
 
     if not native_backend.available():
         try:
-            from imaginary_tpu.native.build import build
+            # best-available cascade: hosts missing only libwebp-dev get
+            # the no-webp build (absent formats delegate to cv2/PIL, so
+            # every test here still exercises a real roundtrip)
+            from imaginary_tpu.native.build import build_any
 
-            build(verbose=False)
+            build_any(verbose=False)
         except Exception as e:
             pytest.skip(f"native build failed: {e}")
         import importlib

@@ -212,6 +212,66 @@ def test_pdf_mini_fuzz_never_crashes(testdata):
     assert pdf_mini.rasterize(buf).shape == (160, 240, 4)
 
 
+def _mini_pdf(objects: dict) -> bytes:
+    """Assemble a minimal classic-xref PDF from {num: object_body} (the
+    body goes between 'N 0 obj' and 'endobj'). Enough structure for _Doc:
+    correct byte offsets, 20-byte xref entries, trailer + startxref."""
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = {}
+    for num in sorted(objects):
+        offsets[num] = len(out)
+        out += b"%d 0 obj\n" % num
+        out += objects[num]
+        out += b"\nendobj\n"
+    xref_off = len(out)
+    top = max(objects) + 1
+    out += b"xref\n0 %d\n" % top
+    out += b"0000000000 65535 f \n"
+    for num in range(1, top):
+        out += b"%010d 00000 n \n" % offsets.get(num, 0)
+    out += b"trailer\n<< /Size %d /Root 1 0 R >>\nstartxref\n%d\n%%%%EOF\n" % (
+        top, xref_off)
+    return bytes(out)
+
+
+def test_pdf_mini_decompression_bomb_refused(monkeypatch):
+    """A few KB of crafted deflate must not expand to whatever it asks
+    for: stream_data inflates in bounded chunks and refuses past the
+    budget (the 64 MB BODY cap never bounded the decompressed size)."""
+    import zlib
+
+    from imaginary_tpu.codecs import pdf_mini
+
+    bomb = zlib.compress(b"\x00" * (4 * 1024 * 1024), 9)  # ~4 KB -> 4 MB
+    assert len(bomb) < 16 * 1024
+    body = (b"<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(bomb)
+            + bomb + b"\nendstream")
+    doc = pdf_mini._Doc(_mini_pdf({1: body}))
+    sobj = doc.obj(pdf_mini._Ref(1))
+    assert isinstance(sobj, tuple)
+    monkeypatch.setattr(pdf_mini, "_MAX_STREAM_BYTES", 1024 * 1024)
+    with pytest.raises(pdf_mini.UnsupportedPdf, match="decompression budget"):
+        doc.stream_data(sobj)
+    # under the budget the same machinery inflates normally
+    monkeypatch.setattr(pdf_mini, "_MAX_STREAM_BYTES", 8 * 1024 * 1024)
+    assert doc.stream_data(sobj) == b"\x00" * (4 * 1024 * 1024)
+
+
+def test_pdf_mini_circular_length_refused():
+    """A /Length resolving back into its own object (directly here; any
+    cycle hits the same guard) must refuse, not RecursionError."""
+    from imaginary_tpu.codecs import pdf_mini
+
+    body = b"<< /Length 1 0 R >>\nstream\nxyzzy\nendstream"
+    doc = pdf_mini._Doc(_mini_pdf({1: body}))
+    with pytest.raises(pdf_mini.UnsupportedPdf, match="circular reference"):
+        doc.obj(pdf_mini._Ref(1))
+    # the guard is re-entrant state, not a poison flag: a later resolve of
+    # a WELL-FORMED object in the same doc still works
+    doc2 = pdf_mini._Doc(_mini_pdf({1: b"<< /Length 5 >>\nstream\nhello\nendstream"}))
+    assert doc2.obj(pdf_mini._Ref(1))[1] == b"hello"
+
+
 def _rss_mb():
     with open("/proc/self/status") as f:
         for line in f:

@@ -816,6 +816,81 @@ class TestQueueDepthAdmission:
 
 
 
+class TestInflightLedgerOnCancellation:
+    """Regression (the --max-queue-ms latch-shut leak): a request
+    cancelled while its pool task is still QUEUED never runs
+    _process_sync (whose finally normally decrements _inflight). The
+    submit + done-callback path must balance the ledger for exactly the
+    cancelled-while-queued outcome — and only that one."""
+
+    def test_cancelled_queued_request_releases_inflight(self):
+        import threading
+
+        from aiohttp.test_utils import make_mocked_request
+
+        async def fn(client, _):
+            svc = client.app["service"]
+            release = threading.Event()
+            started = threading.Event()
+
+            def blocker():
+                started.set()
+                release.wait(15)
+
+            # saturate every pool worker so the next request sits queued
+            blockers = [svc.pool.submit(blocker)
+                        for _ in range(svc._pool_workers)]
+            assert started.wait(5)
+            base = svc._inflight
+            # drive the real handler coroutine and cancel it the way a
+            # disconnect-cancelled request would be (aiohttp's default
+            # config doesn't cancel handlers, but middleware timeouts and
+            # handler_cancellation deployments do — the ledger must
+            # survive either way)
+            req = make_mocked_request("POST", "/resize?width=100")
+            task = asyncio.ensure_future(
+                svc._process_and_respond(req, "resize",
+                                         fixture_bytes("imaginary.jpg")))
+            # wait for the handler to increment the ledger and enqueue its
+            # pool task (it can never START: all workers are blocked)
+            for _ in range(500):
+                if svc._inflight > base:
+                    break
+                await asyncio.sleep(0.01)
+            assert svc._inflight == base + 1
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            # the done-callback fires when the cancelled pool task is
+            # discarded; give it a beat
+            for _ in range(500):
+                if svc._inflight == base:
+                    break
+                await asyncio.sleep(0.01)
+            assert svc._inflight == base, "cancelled-while-queued leaked"
+            release.set()
+            for b in blockers:
+                b.result(timeout=10)
+
+        run(ServerOptions(cpus=1), fn)
+
+    def test_completed_request_never_double_decrements(self):
+        async def fn(client, _):
+            svc = client.app["service"]
+            for _ in range(3):
+                resp = await client.post(
+                    "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+                assert resp.status == 200
+            # ran-to-completion futures are not cancelled(), so only
+            # _process_sync's finally decrements: the ledger sits at zero,
+            # not negative
+            assert svc._inflight == 0
+
+        run(ServerOptions(cpus=2), fn)
+
+
 class TestMetricsEndpoint:
     """Prometheus /metrics (above-reference: SURVEY 5.5 notes the
     reference has no Prometheus surface). Same numbers as /health in
