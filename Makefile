@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench serve clean gate lint
+.PHONY: all native test bench bench-cache serve clean gate lint
 
 all: native test
 
@@ -13,7 +13,9 @@ gate: lint test
 	python __graft_entry__.py
 	BENCH_DURATION=2 BENCH_THREADS=8 python bench.py || \
 	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + bench all pass"
+	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_cache.py || \
+	  { echo "bench_cache.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + bench + cache-bench all pass"
 
 # correctness-class lint (ruff.toml). FAILS the gate when ruff finds an
 # issue; hosts without ruff installed skip with a notice (the bench
@@ -38,6 +40,11 @@ bench:
 
 bench-latency:
 	python bench_latency.py
+
+# cache-tier rows (zipf hot-URL + 32-way coalescing); exits nonzero when
+# the zipf row shows zero hits or coalescing executed one run per request
+bench-cache:
+	python bench_cache.py
 
 docker:
 	docker build -t imaginary-tpu .

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Cache-tier benchmark: the two rows the cache subsystem is graded on.
+
+Row 1 (`cache_zipf_hot_url`): a zipf-distributed hot-URL workload over 64
+distinct remote sources served by a local origin — the shape of real CDN
+traffic, where a few URLs absorb most requests. Run twice on the same
+host: caches off (every request pays fetch -> decode -> process -> encode)
+and caches on (result + frame + source tiers + coalescing). Reports
+throughput for both, the ratio, and the result-tier hit ratio.
+
+Row 2 (`cache_coalesce_32way`): waves of 32 byte-identical concurrent
+requests with ONLY the singleflight coalescer enabled — executed pipelines
+must come out far below request count, visible via the coalesce counter.
+
+Prints one JSON line per row on stdout; human detail on stderr. Exits
+nonzero when the zipf row shows no cache hits or the coalesce row executed
+as many pipelines as it received requests (the `make bench-cache` gate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from bench_util import ensure_native_built, free_port, make_1080p_jpeg, pctl
+
+N_URLS = 64
+ZIPF_S = 1.1  # zipf exponent: rank-1 absorbs ~18% of traffic at 64 URLs
+
+
+def _zipf_indices(n: int, k: int, s: float) -> list:
+    rng = np.random.default_rng(11)
+    p = 1.0 / np.arange(1, k + 1) ** s
+    p /= p.sum()
+    return [int(i) for i in rng.choice(k, size=n, p=p)]
+
+
+async def _start_origin(variants: list):
+    """Local origin serving the distinct source images (distinct digests:
+    each variant carries a unique post-EOI suffix — decoders stop at EOI,
+    so decode work is identical while content-addressing sees 64 sources)."""
+    from aiohttp import web
+
+    async def img(request):
+        i = int(request.match_info["i"])
+        return web.Response(body=variants[i], content_type="image/jpeg")
+
+    app = web.Application()
+    app.router.add_get("/img/{i}", img)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    port = free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _start_server(options):
+    import io
+
+    from aiohttp import web
+
+    from imaginary_tpu.web.app import create_app
+
+    app = create_app(options, log_stream=io.StringIO())
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    port = free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner, app, f"http://127.0.0.1:{port}"
+
+
+async def _closed_loop(session, urls_iter, concurrency: int, duration: float):
+    """Closed-loop client swarm: each worker issues the next request the
+    moment the previous completes. Returns (ok, errors, lats_ms, elapsed)."""
+    deadline = time.monotonic() + duration
+    lats: list = []
+    errors = [0]
+
+    async def worker():
+        while time.monotonic() < deadline:
+            url = next(urls_iter)
+            t0 = time.monotonic()
+            try:
+                async with session.get(url) as res:
+                    await res.read()
+                    if res.status != 200:
+                        errors[0] += 1
+                        continue
+            except Exception:
+                errors[0] += 1
+                continue
+            lats.append((time.monotonic() - t0) * 1000.0)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*[worker() for _ in range(concurrency)])
+    return len(lats), errors[0], lats, time.monotonic() - t0
+
+
+async def _zipf_run(options, variants, duration: float, concurrency: int):
+    import itertools
+
+    import aiohttp
+
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(options)
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = [
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ]
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            # warmup outside the timed window: XLA batch-ladder compiles
+            # and the first origin fetches must not skew either arm
+            for u in urls[:4]:
+                async with session.get(u) as r:
+                    await r.read()
+            ok, errors, lats, elapsed = await _closed_loop(
+                session, itertools.cycle(urls), concurrency, duration
+            )
+        stats = app["service"].caches.to_dict()
+        return ok / elapsed if elapsed else 0.0, lats, errors, stats
+    finally:
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+
+
+async def _coalesce_run(options, buf: bytes, duration: float, wave: int):
+    import aiohttp
+
+    server_runner, app, base = await _start_server(options)
+    try:
+        url = f"{base}/resize?width=300&height=200"
+        conn = aiohttp.TCPConnector(limit=0)
+        requests = 0
+        async with aiohttp.ClientSession(connector=conn) as session:
+            async def one():
+                async with session.post(url, data=buf) as res:
+                    await res.read()
+                    return res.status
+
+            await one()  # warm the compile path
+            deadline = time.monotonic() + duration
+            while time.monotonic() < deadline:
+                statuses = await asyncio.gather(*[one() for _ in range(wave)])
+                assert all(s == 200 for s in statuses)
+                requests += wave
+        stats = app["service"].caches.to_dict()
+        return requests, stats
+    finally:
+        await server_runner.cleanup()
+
+
+def main() -> int:
+    from imaginary_tpu.web.config import ServerOptions
+
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "16"))
+
+    base_jpeg = make_1080p_jpeg()
+    # 64 distinct digests, identical decode cost (suffix rides after EOI)
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+
+    common = dict(enable_url_source=True)
+    opts_off = ServerOptions(**common)
+    opts_on = ServerOptions(
+        cache_result_mb=256.0, cache_frame_mb=512.0, cache_coalesce=True,
+        cache_source_ttl=300.0, cache_source_mb=512.0, **common,
+    )
+
+    print(f"[cache-bench] zipf row: {N_URLS} urls, s={ZIPF_S}, "
+          f"{concurrency} clients x {duration}s per arm", file=sys.stderr)
+    rps_off, lats_off, err_off, _ = asyncio.run(
+        _zipf_run(opts_off, variants, duration, concurrency))
+    rps_on, lats_on, err_on, stats_on = asyncio.run(
+        _zipf_run(opts_on, variants, duration, concurrency))
+
+    lookups = stats_on["result_hits"] + stats_on["result_misses"]
+    hit_ratio = stats_on["result_hits"] / lookups if lookups else 0.0
+    row1 = {
+        "metric": "cache_zipf_hot_url",
+        "unit": "req/s",
+        "value": round(rps_on, 2),
+        "value_cache_off": round(rps_off, 2),
+        "speedup": round(rps_on / rps_off, 2) if rps_off else 0.0,
+        "p50_ms": pctl(lats_on, 0.50),
+        "p99_ms": pctl(lats_on, 0.99),
+        "p50_ms_cache_off": pctl(lats_off, 0.50),
+        "p99_ms_cache_off": pctl(lats_off, 0.99),
+        "errors": err_on + err_off,
+        "result_hit_ratio": round(hit_ratio, 4),
+        "result_hits": stats_on["result_hits"],
+        "source_hits": stats_on["source_hits"],
+        "frame_hits": stats_on["frame_hits"],
+        "coalesced": stats_on["flight_coalesced"],
+    }
+    print(json.dumps(row1))
+
+    print(f"[cache-bench] coalesce row: 32-way identical waves x {duration}s",
+          file=sys.stderr)
+    requests, cstats = asyncio.run(_coalesce_run(
+        ServerOptions(cache_coalesce=True), base_jpeg, duration, 32))
+    executed = cstats["flight_executed"]
+    row2 = {
+        "metric": "cache_coalesce_32way",
+        "unit": "pipeline_runs",
+        "requests": requests,
+        "value": executed,
+        "coalesced": cstats["flight_coalesced"],
+        "dedup_ratio": round(requests / executed, 2) if executed else 0.0,
+    }
+    print(json.dumps(row2))
+
+    ok = True
+    if hit_ratio <= 0.0:
+        print("[cache-bench] FAIL: zipf row saw zero result-cache hits",
+              file=sys.stderr)
+        ok = False
+    if executed >= requests:
+        print("[cache-bench] FAIL: coalescer executed one pipeline per "
+              "request", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,17 @@ def _finish_device_probe(proc, timeout: float = 75.0):
     return False, tail[-1][-300:] if tail else f"probe exit {proc.returncode}"
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "on", "yes")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="imaginary-tpu",
@@ -144,6 +155,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "interpreter (measurement override; device-only "
                         "plans still ride the chip)")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
+    # content-addressed caching (imaginary_tpu/cache.py); every knob also
+    # honors an IMAGINARY_TPU_CACHE_* env override and defaults OFF so the
+    # uncached serving path stays byte-identical to the reference build
+    p.add_argument("--cache-result-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_CACHE_RESULT_MB", 0.0),
+                   help="encoded-result LRU byte budget in MB (0=off); "
+                        "enables strong ETag + If-None-Match 304")
+    p.add_argument("--cache-frame-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_CACHE_FRAME_MB", 0.0),
+                   help="decoded-frame LRU byte budget in MB (0=off)")
+    p.add_argument("--cache-coalesce", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_CACHE_COALESCE"),
+                   help="coalesce concurrent identical requests onto one "
+                        "pipeline run")
+    p.add_argument("--cache-source-ttl", type=float,
+                   default=_env_float("IMAGINARY_TPU_CACHE_SOURCE_TTL", 0.0),
+                   help="TTL seconds for the remote ?url= source cache (0=off)")
+    p.add_argument("--cache-source-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_CACHE_SOURCE_MB", 32.0),
+                   help="remote-source cache byte budget in MB")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
     p.add_argument("--coordinator-address", default="",
@@ -231,6 +262,11 @@ def options_from_args(args) -> ServerOptions:
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         force_host=args.force_host,
         prewarm=args.prewarm,
+        cache_result_mb=max(0.0, args.cache_result_mb),
+        cache_frame_mb=max(0.0, args.cache_frame_mb),
+        cache_coalesce=args.cache_coalesce,
+        cache_source_ttl=max(0.0, args.cache_source_ttl),
+        cache_source_mb=max(0.0, args.cache_source_mb),
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,
         num_processes=args.num_processes or None,

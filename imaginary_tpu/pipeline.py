@@ -176,15 +176,22 @@ def process_operation(
     watermark_fetcher: Optional[WatermarkFetcher] = None,
     runner=None,
     meta=None,
+    frame_cache=None,
+    source_digest=None,
 ) -> ProcessedImage:
     """Run one named operation end-to-end (decode -> device -> encode).
 
     meta: an ImageMetadata the caller already probed (the web layer's
-    resolution guard), so the hot path parses headers exactly once."""
+    resolution guard), so the hot path parses headers exactly once.
+    frame_cache/source_digest: the web layer's decoded-frame LRU
+    (imaginary_tpu/cache.py) plus the sha256 of `buf` — different ops on
+    the same hot source then skip the decode stage."""
     if name == "info":
         return info(buf, o)
     if name == "pipeline":
-        return process_pipeline(buf, o, watermark_fetcher, runner=runner, meta=meta)
+        return process_pipeline(buf, o, watermark_fetcher, runner=runner,
+                                meta=meta, frame_cache=frame_cache,
+                                source_digest=source_digest)
     if name not in OPERATION_NAMES:
         raise new_error(f"Unsupported operation: {name}", 400)
 
@@ -203,14 +210,13 @@ def process_operation(
 
     if _yuv_eligible(src_type, meta, o):
         out = _process_yuv420(name, buf, o, meta, shrink,
-                              watermark_fetcher, runner, t_start)
+                              watermark_fetcher, runner, t_start,
+                              frame_cache, source_digest)
         if out is not None:
             TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
             return out
 
-    t0 = time.monotonic()
-    d = codecs.decode(buf, shrink)
-    TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    d = _decode_cached(buf, shrink, frame_cache, source_digest)
     wm = _fetch_watermark(name, o, watermark_fetcher)
     plan = plan_operation(
         name, o, d.array.shape[0], d.array.shape[1], d.orientation,
@@ -239,11 +245,41 @@ def _yuv_eligible(src_type, meta, o: ImageOptions) -> bool:
         return False
 
 
-def _decode_yuv_packed(buf, shrink, sh, sw):
+def _decode_cached(buf, shrink, frame_cache=None, digest=None):
+    """codecs.decode fronted by the decoded-frame LRU (cache.py). Cached
+    arrays are marked read-only before sharing: every consumer (device
+    launch copies into the batch stack, the host interpreter and encoders
+    only read) treats inputs as immutable, and a hot frame served to many
+    concurrent requests must stay that way."""
+    t0 = time.monotonic()
+    key = None
+    if frame_cache is not None and digest is not None:
+        key = (digest, shrink, "rgb")
+        d = frame_cache.get(key)
+        if d is not None:
+            TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+            return d
+    d = codecs.decode(buf, shrink)
+    if key is not None:
+        d.array.setflags(write=False)
+        frame_cache.put(key, d, d.array.nbytes)
+    TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    return d
+
+
+def _decode_yuv_packed(buf, shrink, sh, sw, frame_cache=None, digest=None):
     """Raw-decode into the packed layout; None means 'use the RGB path'
     (non-420 surprises, raw decode trouble, probe/decode disagreement —
-    the RGB decode then raises any user-facing error itself)."""
+    the RGB decode then raises any user-facing error itself). The packed
+    transport buffer caches under its own kind tag — it is a different
+    pixel layout than the RGB decode of the same digest."""
     hb, wb = bucket_shape(sh, sw)
+    key = None
+    if frame_cache is not None and digest is not None:
+        key = (digest, shrink, "yuv", hb, wb)
+        hit = frame_cache.get(key)
+        if hit is not None:
+            return hit
     t0 = time.monotonic()
     try:
         packed, h, w, _orient = codecs.decode_yuv420(buf, shrink, hb, wb)
@@ -252,11 +288,15 @@ def _decode_yuv_packed(buf, shrink, sh, sw):
     if (h, w) != (sh, sw):
         return None
     TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    if key is not None:
+        packed.setflags(write=False)
+        frame_cache.put(key, (packed, hb, wb), packed.nbytes)
     return packed, hb, wb
 
 
 def _process_yuv420(name, buf, o, meta, shrink, watermark_fetcher, runner,
-                    t_start) -> Optional[ProcessedImage]:
+                    t_start, frame_cache=None,
+                    source_digest=None) -> Optional[ProcessedImage]:
     """Serve a JPEG->JPEG request over the packed-plane transport.
 
     Returns None to fall back to the RGB path — parameter-validation errors
@@ -266,7 +306,7 @@ def _process_yuv420(name, buf, o, meta, shrink, watermark_fetcher, runner,
     """
     sh = -(-meta.height // shrink)
     sw = -(-meta.width // shrink)
-    got = _decode_yuv_packed(buf, shrink, sh, sw)
+    got = _decode_yuv_packed(buf, shrink, sh, sw, frame_cache, source_digest)
     if got is None:
         return None
     packed, hb, wb = got
@@ -317,6 +357,8 @@ def process_pipeline(
     watermark_fetcher: Optional[WatermarkFetcher] = None,
     runner=None,
     meta=None,
+    frame_cache=None,
+    source_digest=None,
 ) -> ProcessedImage:
     """Fused multi-op pipeline (ref: Pipeline, image.go:379-410).
 
@@ -361,7 +403,8 @@ def process_pipeline(
     if ops_keep_jpeg and _yuv_eligible(src_type, meta, o):
         sh = -(-meta.height // shrink)
         sw = -(-meta.width // shrink)
-        got = _decode_yuv_packed(buf, shrink, sh, sw)
+        got = _decode_yuv_packed(buf, shrink, sh, sw, frame_cache,
+                                 source_digest)
         if got is not None:
             packed, hb, wb = got
             combined, final_o, target, rotated, strip = _build_pipeline_plan(
@@ -380,7 +423,7 @@ def process_pipeline(
             return _carry_metadata(buf, strip, out, rotated,
                                    combined.out_w, combined.out_h)
 
-    d = codecs.decode(buf, shrink)
+    d = _decode_cached(buf, shrink, frame_cache, source_digest)
     combined, final_o, target, rotated, strip = _build_pipeline_plan(
         o, d.array.shape[0], d.array.shape[1], d.orientation,
         d.array.shape[2], d.type, watermark_fetcher,

@@ -79,6 +79,22 @@ class ServerOptions:
     # override for bench_latency's host-path rows; see ExecutorConfig).
     force_host: bool = False
     prewarm: bool = False
+    # --- content-addressed caching (imaginary_tpu/cache.py) ------------------
+    # All tiers default OFF: with every knob at 0/False the serving path is
+    # byte-identical to the uncached build (PARITY.md "Cache semantics").
+    # encoded-result LRU byte budget in MB (serves repeat requests without
+    # touching the executor; also enables strong ETag + If-None-Match 304)
+    cache_result_mb: float = 0.0
+    # decoded-frame LRU byte budget in MB (digest -> ndarray; different ops
+    # on the same hot source skip decode)
+    cache_frame_mb: float = 0.0
+    # singleflight: N concurrent identical (digest, plan) requests run the
+    # pipeline once and fan the result out
+    cache_coalesce: bool = False
+    # TTL'd remote-source cache for ?url= fetches: seconds (0 = off) and
+    # its own byte budget
+    cache_source_ttl: float = 0.0
+    cache_source_mb: float = 32.0
     # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
     distributed: bool = False
     coordinator_address: str = ""

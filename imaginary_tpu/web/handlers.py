@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 from aiohttp import web
 
+from imaginary_tpu import cache as cache_mod
 from imaginary_tpu import codecs
 from imaginary_tpu.engine import Executor, ExecutorConfig
 from imaginary_tpu.errors import (
@@ -68,7 +69,13 @@ class ImageService:
 
     def __init__(self, o: ServerOptions):
         self.options = o
-        self.registry = SourceRegistry(o)
+        # content-addressed cache tiers (imaginary_tpu/cache.py): result
+        # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
+        # remote-source TTL cache the registry consumes. All default off.
+        self.caches = cache_mod.CacheSet.from_options(o)
+        self.frame_cache = cache_mod.FrameCache(self.caches.frames,
+                                                self.caches.stats)
+        self.registry = SourceRegistry(o, caches=self.caches)
         self.executor = Executor(
             ExecutorConfig(
                 window_ms=o.batch_window_ms,
@@ -176,39 +183,88 @@ class ImageService:
                     raise
                 # probe failure falls through; decode will produce the error
 
-        wm_rgba = await self._prefetch_watermark(request, op_name, opts)
-        # Inflight is incremented HERE and normally decremented inside
-        # _process_sync's own finally, in the pool thread — NOT in an
-        # async finally: a client disconnect cancels this coroutine while
-        # the worker thread keeps running, and decrementing on
-        # cancellation would collapse the backlog signal to ~0 exactly at
-        # overload (mass client timeouts), failing the admission gate
-        # open when it matters most. The one case _process_sync's finally
-        # can never cover: a task cancelled while still QUEUED in the
-        # pool never starts, so the done-callback balances the ledger for
-        # exactly the fut.cancelled() outcome (run_in_executor can't
-        # express this — its asyncio future abandons the pool task
-        # without cancelling it; submit + wrap_future propagates the
-        # cancellation into the pool queue). Without it every cancelled-
-        # while-queued request leaked one _inflight forever, inflating
-        # estimated_queue_ms until --max-queue-ms latched shut.
-        with self._inflight_lock:
-            self._inflight += 1
-        fut = self.pool.submit(self._process_sync, op_name, buf, opts,
-                               wm_rgba, meta)
-        fut.add_done_callback(self._release_if_cancelled)
+        # --- content-addressed cache tiers (imaginary_tpu/cache.py) -------
+        # The key derives from sha256(source bytes) + the canonicalized
+        # operation, AFTER Accept negotiation resolved type=auto — so a
+        # negotiated webp and jpeg never share an entry or an ETag.
+        caches = self.caches
+        digest = key = etag = None
+        if caches.keyed or caches.frames.enabled:
+            digest = cache_mod.source_digest(buf)
+        if caches.keyed:
+            key = cache_mod.request_key(digest, op_name, opts)
+        if caches.result.enabled and key is not None:
+            etag = cache_mod.strong_etag(key)
+            if request.method == "GET" and cache_mod.etag_matches(
+                request.headers.get("If-None-Match", ""), etag
+            ):
+                # conditional GET answered before the pipeline runs
+                caches.stats.etag_304 += 1
+                headers = {"ETag": etag}
+                if vary:
+                    headers["Vary"] = vary
+                return web.Response(status=304, headers=headers)
+            hit = caches.result.get(key)
+            if hit is not None:
+                caches.stats.result_hits += 1
+                out, placement = hit
+                return self._build_response(out, placement, vary, etag, o)
+            caches.stats.result_misses += 1
+
+        async def produce():
+            wm_rgba = await self._prefetch_watermark(request, op_name, opts)
+            # Inflight is incremented HERE and normally decremented inside
+            # _process_sync's own finally, in the pool thread — NOT in an
+            # async finally: a client disconnect cancels this coroutine
+            # while the worker thread keeps running, and decrementing on
+            # cancellation would collapse the backlog signal to ~0 exactly
+            # at overload (mass client timeouts), failing the admission
+            # gate open when it matters most. The one case _process_sync's
+            # finally can never cover: a task cancelled while still QUEUED
+            # in the pool never starts, so the done-callback balances the
+            # ledger for exactly the fut.cancelled() outcome
+            # (run_in_executor can't express this — its asyncio future
+            # abandons the pool task without cancelling it; submit +
+            # wrap_future propagates the cancellation into the pool
+            # queue). Without it every cancelled-while-queued request
+            # leaked one _inflight forever, inflating estimated_queue_ms
+            # until --max-queue-ms latched shut.
+            with self._inflight_lock:
+                self._inflight += 1
+            fut = self.pool.submit(self._process_sync, op_name, buf, opts,
+                                   wm_rgba, meta, digest)
+            fut.add_done_callback(self._release_if_cancelled)
+            return await asyncio.wrap_future(fut)
+
         try:
-            out, placement = await asyncio.wrap_future(fut)
+            if caches.coalesce and key is not None:
+                # singleflight: N concurrent identical (digest, plan)
+                # requests run produce() ONCE — one _inflight unit, one
+                # pipeline run — and every waiter (shielded, so a client
+                # disconnect detaches without cancelling the group) gets
+                # the same result or the same error
+                out, placement = await caches.flight.run(key, produce)
+            else:
+                out, placement = await produce()
         except ImageError:
             raise
         except Exception as e:
             raise new_error("Error processing image: " + str(e), 400) from None
 
+        if caches.result.enabled and key is not None:
+            # placement rides along so a replayed response carries the
+            # same X-Imaginary-Backend facts as the run that produced it
+            caches.result.put(key, (out, placement), len(out.body))
+        return self._build_response(out, placement, vary, etag, o)
+
+    def _build_response(self, out, placement, vary, etag, o) -> web.Response:
         headers = {}
         if placement:
             headers["X-Imaginary-Backend"] = placement
         if vary:
             headers["Vary"] = vary
+        if etag:
+            headers["ETag"] = etag
         if o.return_size and out.mime != "application/json":
             try:
                 m = codecs.probe(out.body)
@@ -250,28 +306,33 @@ class ImageService:
             with self._inflight_lock:
                 self._inflight -= 1
 
-    def _process_sync(self, op_name, buf, opts, wm_rgba, meta=None):
+    def _process_sync(self, op_name, buf, opts, wm_rgba, meta=None,
+                      digest=None):
         # Service-time EWMA measured INSIDE the worker thread: stamping
         # at submission would fold pool queue-wait into "service time"
         # and make estimated_queue_ms count the backlog twice (backlog x
         # inflated-EWMA grows quadratically with queue depth).
         t0 = time.monotonic()
         try:
-            return self._process_sync_inner(op_name, buf, opts, wm_rgba, meta)
+            return self._process_sync_inner(op_name, buf, opts, wm_rgba,
+                                            meta, digest)
         finally:
             dt_ms = (time.monotonic() - t0) * 1000.0
             with self._inflight_lock:
                 self._inflight -= 1
                 self._service_ewma_ms += 0.1 * (dt_ms - self._service_ewma_ms)
 
-    def _process_sync_inner(self, op_name, buf, opts, wm_rgba, meta=None):
+    def _process_sync_inner(self, op_name, buf, opts, wm_rgba, meta=None,
+                            digest=None):
         from imaginary_tpu.engine.executor import last_placement, reset_placement
 
         fetcher = (lambda url: wm_rgba) if wm_rgba is not None else None
+        frames = self.frame_cache if self.frame_cache.enabled else None
         reset_placement()
         out = process_operation(
             op_name, buf, opts, watermark_fetcher=fetcher,
             runner=self.executor.process, meta=meta,
+            frame_cache=frames, source_digest=digest,
         )
         # placement was recorded by submit() on THIS worker thread
         return out, last_placement()
@@ -295,6 +356,9 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads
         stats["estimatedQueueMs"] = round(service.estimated_queue_ms(), 2)
+        # cache tier counters (hit/miss/eviction/coalesce), same
+        # Executor.stats()-style dict /metrics renders as gauges
+        stats["cache"] = service.caches.to_dict()
     return stats
 
 

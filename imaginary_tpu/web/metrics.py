@@ -34,6 +34,11 @@ def render_metrics(stats: dict) -> str:
         if key == "executor" and isinstance(value, dict):
             for k, v in value.items():
                 _emit(lines, f"imaginary_tpu_executor_{_snake(k)}", v)
+        elif key == "cache" and isinstance(value, dict):
+            # cache tier counters (imaginary_tpu/cache.py): hit/miss/
+            # eviction per tier + singleflight coalescing + 304s
+            for k, v in value.items():
+                _emit(lines, f"imaginary_tpu_cache_{_snake(k)}", v)
         elif key == "stageTimesMs" and isinstance(value, dict):
             for stage, pcts in value.items():
                 for q, v in pcts.items():

@@ -34,30 +34,42 @@ def default_placeholder() -> bytes:
     return codecs.encode(arr, EncodeOptions(type=ImageType.JPEG, quality=85))
 
 
+@functools.lru_cache(maxsize=64)
+def _resized_placeholder(buf: bytes, width: int, height: int,
+                         type_name: str) -> tuple:
+    """Resize the placeholder once per (source, width, height, type).
+
+    An error STORM re-requests the same few shapes thousands of times;
+    re-running the full resize pipeline per errored request amplified the
+    very load that caused the errors. Keyed on the placeholder bytes too,
+    so a custom -placeholder never serves another placeholder's pixels.
+    Exceptions are not cached by lru_cache, so a failing resize keeps
+    falling back to the JSON error exactly as before."""
+    from imaginary_tpu.pipeline import process_operation
+
+    opts = ImageOptions(width=width, height=height, force=True,
+                        type=type_name)
+    out = process_operation("resize", buf, opts)
+    return out.body, out.mime
+
+
 def placeholder_response(request: web.Request, err: ImageError,
                          o: ServerOptions) -> Optional[web.Response]:
     """Build the placeholder reply; None falls back to the JSON error
     (mirrors replyWithPlaceholder's own error path, error.go:90-93)."""
-    from imaginary_tpu.pipeline import process_operation
-
     buf = o.placeholder_image or default_placeholder()
     try:
         width = parse_int(request.query.get("width", ""))
         height = parse_int(request.query.get("height", ""))
     except Exception:
         return None
-    opts = ImageOptions(
-        width=width or 0,
-        height=height or 0,
-        force=True,
-        type=request.query.get("type", ""),
-    )
-    if opts.type and image_type(opts.type) is ImageType.UNKNOWN:
-        opts.type = ""
+    type_name = request.query.get("type", "")
+    if type_name and image_type(type_name) is ImageType.UNKNOWN:
+        type_name = ""
     try:
-        if opts.width or opts.height:
-            out = process_operation("resize", buf, opts)
-            body, mime = out.body, out.mime
+        if width or height:
+            body, mime = _resized_placeholder(buf, width or 0, height or 0,
+                                              type_name)
         else:
             body, mime = buf, get_image_mime_type(ImageType.JPEG)
     except Exception:

@@ -111,12 +111,15 @@ class FileSystemImageSource:
 
 class HTTPImageSource:
     """GET ?url= remote fetch with origin allow-list, HEAD size pre-check,
-    and auth/header forwarding (ref: source_http.go:24-160)."""
+    and auth/header forwarding (ref: source_http.go:24-160). When the
+    TTL'd source cache (imaginary_tpu/cache.py, --cache-source-ttl) is
+    enabled, a hot URL is fetched from the origin once per TTL window."""
 
     name = "http"
 
-    def __init__(self, o: ServerOptions):
+    def __init__(self, o: ServerOptions, caches=None):
         self.options = o
+        self._caches = caches
         self._session: Optional[aiohttp.ClientSession] = None
 
     def matches(self, request: web.Request) -> bool:
@@ -148,6 +151,18 @@ class HTTPImageSource:
                     limit: Optional[int] = None) -> bytes:
         sess = await self.session()
         headers = self._build_headers(request)
+        # TTL'd source cache: keyed by URL + the exact header set the
+        # origin would see (auth forwarding means two users can receive
+        # different bytes for one URL — they must not share an entry)
+        ckey = None
+        caches = self._caches
+        if caches is not None and caches.source.enabled:
+            ckey = (url, limit, tuple(sorted(headers.items())))
+            hit = caches.source.get(ckey)
+            if hit is not None:
+                caches.stats.source_hits += 1
+                return hit
+            caches.stats.source_misses += 1
         max_size = limit or self.options.max_allowed_size
         if self.options.max_allowed_size > 0 and limit is None:
             await self._check_size(sess, url, headers)
@@ -162,9 +177,15 @@ class HTTPImageSource:
                 async for chunk in res.content.iter_chunked(1 << 16):
                     data.extend(chunk)
                     if max_size and len(data) > max_size:
-                        data = data[:max_size]  # LimitReader semantics
-                        break
-                return bytes(data)
+                        # Deliberate parity divergence (PARITY.md §2.5-2.8):
+                        # the reference's LimitReader silently truncates an
+                        # oversize body and hands the pipeline corrupt image
+                        # bytes; rejecting is the only honest rendering.
+                        raise ErrEntityTooLarge
+                body = bytes(data)
+                if ckey is not None:
+                    caches.source.put(ckey, body, len(body))
+                return body
         except ImageError:
             raise
         except Exception as e:
@@ -231,13 +252,14 @@ class SourceRegistry:
     """Deterministic-order source matching (ref: source.go:33-99, minus the
     map-iteration nondeterminism flagged in SURVEY.md section 2.13)."""
 
-    def __init__(self, o: ServerOptions):
+    def __init__(self, o: ServerOptions, caches=None):
         self.options = o
+        self._caches = caches
         self.sources: list = [BodyImageSource()]
         if o.mount:
             self.sources.append(FileSystemImageSource(o.mount))
         if o.enable_url_source:
-            self.sources.append(HTTPImageSource(o))
+            self.sources.append(HTTPImageSource(o, caches=caches))
 
     def match(self, request: web.Request):
         for s in self.sources:
@@ -262,7 +284,7 @@ class SourceRegistry:
             raise new_error(f"Unable to retrieve watermark image: {url}", 400)
         http_source = next((s for s in self.sources if isinstance(s, HTTPImageSource)), None)
         if http_source is None:
-            http_source = HTTPImageSource(self.options)
+            http_source = HTTPImageSource(self.options, caches=self._caches)
             self.sources.append(http_source)
         return await http_source.fetch(url, None, limit=WATERMARK_MAX_BYTES)
 

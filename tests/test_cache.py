@@ -1,0 +1,531 @@
+"""Content-addressed cache subsystem (imaginary_tpu/cache.py).
+
+Covers the acceptance list from the cache PR: LRU hit/miss/eviction under
+a byte budget, ETag/If-None-Match -> 304, singleflight fan-out (one
+pipeline run for N concurrent identical requests, error propagated to all
+waiters, no _inflight leak on waiter cancellation), cache-off parity
+(all tiers disabled => byte-identical responses to uncached behavior),
+the decoded-frame tier, the TTL'd remote-source tier, and the
+oversize-remote-body rejection that replaced LimitReader truncation.
+"""
+
+import asyncio
+import io
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from imaginary_tpu import cache as cache_mod
+from imaginary_tpu.web.config import ServerOptions
+from tests.conftest import fixture_bytes
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+def run(options, fn, origin_handler=None):
+    """Run `fn(client, origin_url, app)` against a fresh app instance
+    (test_server.py's harness, plus the app handle so tests can reach
+    service.caches counters)."""
+
+    async def runner():
+        from imaginary_tpu.web.app import create_app
+
+        origin_url = None
+        origin = None
+        if origin_handler is not None:
+            oapp = web.Application()
+            oapp.router.add_route("*", "/{tail:.*}", origin_handler)
+            origin = TestServer(oapp)
+            await origin.start_server()
+            origin_url = f"http://127.0.0.1:{origin.port}"
+
+        app = create_app(options, log_stream=io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, origin_url, app)
+        finally:
+            await client.close()
+            if origin is not None:
+                await origin.close()
+
+    asyncio.run(runner())
+
+
+def jpg() -> bytes:
+    return fixture_bytes("imaginary.jpg")
+
+
+# --- ByteBudgetLRU unit behavior ---------------------------------------------
+
+class TestByteBudgetLRU:
+    def test_hit_miss_and_lru_order(self):
+        lru = cache_mod.ByteBudgetLRU(100)
+        assert lru.get("a") is None
+        lru.put("a", b"xxxx", 40)
+        lru.put("b", b"yyyy", 40)
+        assert lru.get("a") == b"xxxx"  # refreshes a's recency
+        lru.put("c", b"zzzz", 40)  # budget 100: evicts b (LRU), not a
+        assert lru.get("b") is None
+        assert lru.get("a") == b"xxxx"
+        assert lru.get("c") == b"zzzz"
+
+    def test_eviction_respects_byte_budget_and_counts(self):
+        evicted = []
+        lru = cache_mod.ByteBudgetLRU(100, on_evict=evicted.append)
+        for i in range(5):
+            lru.put(i, i, 30)  # 5 x 30 > 100: two must go
+        assert lru.bytes_used <= 100
+        assert sum(evicted) == 2
+        assert len(lru) == 3
+
+    def test_oversize_entry_refused(self):
+        lru = cache_mod.ByteBudgetLRU(100)
+        lru.put("big", b"x", 101)
+        assert lru.get("big") is None
+        assert lru.bytes_used == 0
+
+    def test_replace_same_key_adjusts_bytes(self):
+        lru = cache_mod.ByteBudgetLRU(100)
+        lru.put("a", 1, 60)
+        lru.put("a", 2, 30)
+        assert lru.bytes_used == 30
+        assert lru.get("a") == 2
+
+    def test_zero_budget_disabled(self):
+        lru = cache_mod.ByteBudgetLRU(0)
+        assert not lru.enabled
+        lru.put("a", 1, 1)
+        assert lru.get("a") is None
+
+    def test_ttl_expiry(self, monkeypatch):
+        import time as time_mod
+
+        now = [1000.0]
+        monkeypatch.setattr(cache_mod.time, "monotonic", lambda: now[0])
+        lru = cache_mod.ByteBudgetLRU(100, ttl_s=5.0)
+        lru.put("a", b"v", 10)
+        assert lru.get("a") == b"v"
+        now[0] += 6.0
+        assert lru.get("a") is None
+        assert len(lru) == 0
+        del time_mod  # silence linters; monkeypatch target is cache_mod.time
+
+
+# --- key derivation / ETag ----------------------------------------------------
+
+class TestKeys:
+    def test_key_sensitive_to_source_op_and_options(self):
+        from imaginary_tpu.options import ImageOptions
+
+        d1 = cache_mod.source_digest(b"abc")
+        d2 = cache_mod.source_digest(b"abd")
+        o1 = ImageOptions(width=100)
+        o2 = ImageOptions(width=101)
+        k = cache_mod.request_key
+        assert k(d1, "resize", o1) == k(d1, "resize", ImageOptions(width=100))
+        assert k(d1, "resize", o1) != k(d2, "resize", o1)
+        assert k(d1, "resize", o1) != k(d1, "crop", o1)
+        assert k(d1, "resize", o1) != k(d1, "resize", o2)
+
+    def test_key_covers_pipeline_operations(self):
+        from imaginary_tpu.options import ImageOptions, PipelineOperation
+
+        d = cache_mod.source_digest(b"abc")
+        o1 = ImageOptions(operations=[
+            PipelineOperation(name="crop", params={"width": 100})])
+        o2 = ImageOptions(operations=[
+            PipelineOperation(name="crop", params={"width": 200})])
+        assert (cache_mod.request_key(d, "pipeline", o1)
+                != cache_mod.request_key(d, "pipeline", o2))
+
+    def test_strong_etag_stable_and_quoted(self):
+        from imaginary_tpu.options import ImageOptions
+
+        d = cache_mod.source_digest(b"abc")
+        k = cache_mod.request_key(d, "resize", ImageOptions(width=9))
+        e1 = cache_mod.strong_etag(k)
+        e2 = cache_mod.strong_etag(
+            cache_mod.request_key(d, "resize", ImageOptions(width=9)))
+        assert e1 == e2
+        assert e1.startswith('"') and e1.endswith('"')
+
+    def test_etag_match_list_and_star(self):
+        m = cache_mod.etag_matches
+        assert m('"abc"', '"abc"')
+        assert m('"x", "abc"', '"abc"')
+        assert m("*", '"abc"')
+        assert not m('W/"abc"', '"abc"')
+        assert not m("", '"abc"')
+
+
+# --- singleflight -------------------------------------------------------------
+
+class TestSingleflight:
+    def test_fanout_and_leader_counts(self):
+        async def go():
+            sf = cache_mod.Singleflight()
+            runs = []
+
+            async def thunk():
+                runs.append(1)
+                await asyncio.sleep(0.05)
+                return "v"
+
+            got = await asyncio.gather(*[sf.run("k", thunk) for _ in range(8)])
+            assert got == ["v"] * 8
+            assert len(runs) == 1
+            assert sf.stats.flight_executed == 1
+            assert sf.stats.flight_coalesced == 7
+            assert sf.inflight() == 0
+
+        asyncio.run(go())
+
+    def test_error_propagates_to_all_waiters(self):
+        async def go():
+            sf = cache_mod.Singleflight()
+
+            async def thunk():
+                await asyncio.sleep(0.02)
+                raise ValueError("boom")
+
+            results = await asyncio.gather(
+                *[sf.run("k", thunk) for _ in range(4)], return_exceptions=True
+            )
+            assert all(isinstance(r, ValueError) for r in results)
+            assert sf.inflight() == 0
+
+        asyncio.run(go())
+
+    def test_waiter_cancellation_does_not_cancel_group(self):
+        async def go():
+            sf = cache_mod.Singleflight()
+            done = asyncio.Event()
+
+            async def thunk():
+                await asyncio.sleep(0.05)
+                done.set()
+                return "v"
+
+            leader = asyncio.ensure_future(sf.run("k", thunk))
+            await asyncio.sleep(0.01)
+            waiter = asyncio.ensure_future(sf.run("k", thunk))
+            await asyncio.sleep(0.01)
+            waiter.cancel()
+            # the cancelled waiter detaches; the group still completes and
+            # the leader still gets the value
+            assert await leader == "v"
+            assert done.is_set()
+            assert sf.inflight() == 0
+
+        asyncio.run(go())
+
+    def test_leader_request_cancellation_keeps_group_running(self):
+        async def go():
+            sf = cache_mod.Singleflight()
+            done = asyncio.Event()
+
+            async def thunk():
+                await asyncio.sleep(0.05)
+                done.set()
+                return "v"
+
+            leader = asyncio.ensure_future(sf.run("k", thunk))
+            await asyncio.sleep(0.01)
+            follower = asyncio.ensure_future(sf.run("k", thunk))
+            await asyncio.sleep(0.0)
+            leader.cancel()
+            # the group task is independent of the leader's await: the
+            # follower still gets the result
+            assert await follower == "v"
+            assert done.is_set()
+            assert sf.inflight() == 0
+
+        asyncio.run(go())
+
+
+# --- end-to-end: result cache + ETag over HTTP --------------------------------
+
+def _caches(app):
+    return app["service"].caches
+
+
+class TestResultCacheHTTP:
+    def test_hit_serves_identical_bytes_without_second_run(self):
+        async def fn(client, _origin, app):
+            res1 = await client.post("/resize?width=120&height=80",
+                                     data=jpg())
+            assert res1.status == 200
+            body1 = await res1.read()
+            etag = res1.headers.get("ETag")
+            assert etag  # result tier on => strong ETag on the response
+            res2 = await client.post("/resize?width=120&height=80",
+                                     data=jpg())
+            body2 = await res2.read()
+            assert body2 == body1
+            assert res2.headers.get("ETag") == etag
+            st = _caches(app).stats
+            assert st.result_hits == 1
+            assert st.result_misses == 1
+
+        run(ServerOptions(cache_result_mb=8.0), fn)
+
+    def test_distinct_params_distinct_entries(self):
+        async def fn(client, _origin, app):
+            r1 = await client.post("/resize?width=120&height=80", data=jpg())
+            r2 = await client.post("/resize?width=121&height=80", data=jpg())
+            assert r1.headers["ETag"] != r2.headers["ETag"]
+            assert _caches(app).stats.result_hits == 0
+            assert _caches(app).stats.result_misses == 2
+
+        run(ServerOptions(cache_result_mb=8.0), fn)
+
+    def test_if_none_match_304_before_pipeline(self, monkeypatch):
+        async def fn(client, _origin, app):
+            res1 = await client.get("/resize?width=120&height=80&file=imaginary.jpg")
+            assert res1.status == 200
+            etag = res1.headers["ETag"]
+
+            # a 304 must answer BEFORE the pipeline runs: poison the
+            # process path and prove it is never reached
+            from imaginary_tpu.web.handlers import ImageService
+
+            def boom(*a, **k):
+                raise AssertionError("pipeline ran on a conditional GET hit")
+
+            monkeypatch.setattr(ImageService, "_process_sync", boom)
+            res2 = await client.get(
+                "/resize?width=120&height=80&file=imaginary.jpg",
+                headers={"If-None-Match": etag},
+            )
+            assert res2.status == 304
+            assert res2.headers["ETag"] == etag
+            assert await res2.read() == b""
+            assert _caches(app).stats.etag_304 == 1
+
+            # non-matching validator: full 200 (from cache)
+            res3 = await client.get(
+                "/resize?width=120&height=80&file=imaginary.jpg",
+                headers={"If-None-Match": '"deadbeef"'},
+            )
+            assert res3.status == 200
+
+        import os
+
+        from tests.conftest import FIXTURES
+
+        assert os.path.isdir(FIXTURES)
+        run(ServerOptions(cache_result_mb=8.0, mount=FIXTURES), fn)
+
+    def test_eviction_under_byte_budget_http(self):
+        async def fn(client, _origin, app):
+            # budget sized to hold roughly one encoded result: distinct
+            # requests must evict each other and re-miss
+            for w in (100, 110, 120, 100, 110, 120):
+                res = await client.post(f"/resize?width={w}&height=70",
+                                        data=jpg())
+                assert res.status == 200
+            st = _caches(app).stats
+            assert st.result_evictions > 0
+            assert st.result_hits == 0
+            assert st.result_misses == 6
+
+        # ~3-6 KB per body; 0.006 MB keeps at most one or two
+        run(ServerOptions(cache_result_mb=0.006), fn)
+
+    def test_accept_negotiation_keys_separately(self):
+        async def fn(client, _origin, app):
+            r1 = await client.post("/resize?width=100&type=auto", data=jpg(),
+                                   headers={"Accept": "image/png"})
+            r2 = await client.post("/resize?width=100&type=auto", data=jpg(),
+                                   headers={"Accept": "image/jpeg"})
+            assert r1.headers["Content-Type"] == "image/png"
+            assert r2.headers["Content-Type"] == "image/jpeg"
+            # negotiated outputs must not share an entry or an ETag
+            assert r1.headers["ETag"] != r2.headers["ETag"]
+            assert _caches(app).stats.result_hits == 0
+
+        run(ServerOptions(cache_result_mb=8.0), fn)
+
+
+class TestCoalescingHTTP:
+    def test_n_identical_concurrent_requests_one_pipeline_run(self):
+        async def fn(client, _origin, app):
+            from imaginary_tpu.web import handlers as handlers_mod
+
+            runs = []
+            inner = handlers_mod.ImageService._process_sync_inner
+
+            def counting(self, *a, **k):
+                runs.append(1)
+                return inner(self, *a, **k)
+
+            handlers_mod.ImageService._process_sync_inner = counting
+            try:
+                body = jpg()
+                res = await asyncio.gather(*[
+                    client.post("/resize?width=140&height=90", data=body)
+                    for _ in range(12)
+                ])
+                assert all(r.status == 200 for r in res)
+                bodies = [await r.read() for r in res]
+                assert len(set(bodies)) == 1  # one result fanned out
+            finally:
+                handlers_mod.ImageService._process_sync_inner = inner
+            st = _caches(app).stats
+            assert len(runs) == 1  # the pipeline executed exactly once
+            assert st.flight_executed == 1
+            assert st.flight_coalesced == 11
+            # the group counted as ONE unit of queue pressure and released it
+            assert app["service"]._inflight == 0
+
+        run(ServerOptions(cache_coalesce=True), fn)
+
+    def test_error_fans_out_to_every_waiter_without_inflight_leak(self):
+        async def fn(client, _origin, app):
+            # /extract without area params raises in the pool thread
+            body = jpg()
+            res = await asyncio.gather(*[
+                client.post("/extract?top=10", data=body) for _ in range(6)
+            ])
+            assert all(r.status == 400 for r in res)
+            payloads = [json.loads(await r.read()) for r in res]
+            assert len({p["message"] for p in payloads}) == 1
+            assert app["service"]._inflight == 0
+
+        run(ServerOptions(cache_coalesce=True), fn)
+
+
+class TestFrameCacheHTTP:
+    def test_second_request_on_same_source_skips_decode(self):
+        async def fn(client, _origin, app):
+            # same geometry (=> same shrink-on-load, same frame key) but
+            # different encode quality: distinct results, shared frame
+            r1 = await client.post("/resize?width=130&height=85&quality=80",
+                                   data=jpg())
+            r2 = await client.post("/resize?width=130&height=85&quality=55",
+                                   data=jpg())
+            assert r1.status == 200 and r2.status == 200
+            st = _caches(app).stats
+            assert st.frame_hits >= 1
+
+        run(ServerOptions(cache_frame_mb=64.0), fn)
+
+
+class TestSourceCacheHTTP:
+    def test_hot_url_fetched_once_per_ttl(self):
+        fetches = []
+
+        async def origin(request):
+            fetches.append(request.method)
+            return web.Response(body=jpg(), content_type="image/jpeg")
+
+        async def fn(client, origin_url, app):
+            url = origin_url + "/img.jpg"
+            for _ in range(3):
+                res = await client.get(f"/resize?width=100&url={url}")
+                assert res.status == 200
+            st = _caches(app).stats
+            assert fetches.count("GET") == 1
+            assert st.source_hits == 2
+            assert st.source_misses == 1
+
+        run(ServerOptions(enable_url_source=True, cache_source_ttl=60.0),
+            fn, origin_handler=origin)
+
+    def test_source_cache_off_fetches_every_time(self):
+        fetches = []
+
+        async def origin(request):
+            fetches.append(request.method)
+            return web.Response(body=jpg(), content_type="image/jpeg")
+
+        async def fn(client, origin_url, app):
+            url = origin_url + "/img.jpg"
+            for _ in range(2):
+                res = await client.get(f"/resize?width=100&url={url}")
+                assert res.status == 200
+            assert fetches.count("GET") == 2
+
+        run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
+
+
+class TestOversizeRemoteBody:
+    def test_oversize_streamed_body_rejected_not_truncated(self):
+        async def origin(request):
+            # chunked response (no Content-Length): the HEAD pre-check
+            # cannot catch it, so the streaming guard must
+            resp = web.StreamResponse()
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            if request.method != "HEAD":
+                await resp.write(b"\xff" * 5000)
+            await resp.write_eof()
+            return resp
+
+        async def fn(client, origin_url, app):
+            res = await client.get(f"/resize?width=100&url={origin_url}/big.jpg")
+            # entity-too-large, NOT a 400 corrupt-decode from truncation
+            assert res.status == 413
+            payload = json.loads(await res.read())
+            assert "large" in payload["message"].lower()
+
+        run(ServerOptions(enable_url_source=True, max_allowed_size=1000),
+            fn, origin_handler=origin)
+
+
+class TestCacheOffParity:
+    def test_disabled_tiers_are_byte_identical_to_uncached(self):
+        bodies = {}
+
+        async def capture(label, client):
+            res = await client.post("/resize?width=150&height=100", data=jpg())
+            assert res.status == 200
+            assert "ETag" not in res.headers or label == "on"
+            bodies[label] = await res.read()
+            return res
+
+        async def fn_off(client, _origin, app):
+            res = await capture("off", client)
+            assert "ETag" not in res.headers
+            # default options: every tier reads disabled
+            c = _caches(app)
+            assert not c.result.enabled and not c.frames.enabled
+            assert not c.source.enabled and not c.coalesce
+
+        async def fn_off2(client, _origin, app):
+            await capture("off2", client)
+
+        async def fn_on(client, _origin, app):
+            await capture("on", client)
+
+        run(ServerOptions(), fn_off)
+        run(ServerOptions(), fn_off2)
+        run(ServerOptions(cache_result_mb=8.0, cache_frame_mb=64.0,
+                          cache_coalesce=True), fn_on)
+        # deterministic encode: two uncached runs agree, and the cached
+        # MISS path produces those same bytes (the cache may never alter
+        # response bytes, only skip work)
+        assert bodies["off"] == bodies["off2"]
+        assert bodies["on"] == bodies["off"]
+
+
+class TestHealthAndMetricsSurface:
+    def test_cache_counters_in_health_and_metrics(self):
+        async def fn(client, _origin, app):
+            await client.post("/resize?width=100&height=66", data=jpg())
+            await client.post("/resize?width=100&height=66", data=jpg())
+            health = await (await client.get("/health")).json()
+            assert health["cache"]["result_hits"] == 1
+            assert health["cache"]["result_misses"] == 1
+            assert health["cache"]["result_bytes"] > 0
+            text = await (await client.get("/metrics")).text()
+            assert "imaginary_tpu_cache_result_hits 1" in text
+            assert "imaginary_tpu_cache_result_misses 1" in text
+
+        run(ServerOptions(cache_result_mb=8.0), fn)
