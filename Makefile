@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache serve clean gate lint
+.PHONY: all native test bench bench-cache bench-obs serve clean gate lint
 
 all: native test
 
@@ -15,7 +15,9 @@ gate: lint test
 	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_cache.py || \
 	  { echo "bench_cache.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + bench + cache-bench all pass"
+	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_obs.py || \
+	  { echo "bench_obs.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + bench + cache-bench + obs-bench all pass"
 
 # correctness-class lint (ruff.toml). FAILS the gate when ruff finds an
 # issue; hosts without ruff installed skip with a notice (the bench
@@ -45,6 +47,11 @@ bench-latency:
 # the zipf row shows zero hits or coalescing executed one run per request
 bench-cache:
 	python bench_cache.py
+
+# headline throughput with tracing on vs off (cache-off zipf row); exits
+# nonzero on gross overhead or missing tracing response surfaces
+bench-obs:
+	python bench_obs.py
 
 docker:
 	docker build -t imaginary-tpu .

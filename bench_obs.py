@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Observability overhead benchmark: the row the tracing layer is graded on.
+
+Reuses bench_cache.py's zipf hot-URL harness with every cache tier OFF —
+the cache-off row is the headline number (every request pays fetch ->
+decode -> process -> encode), so per-request tracing cost cannot hide
+behind cache hits. Two arms on the same host:
+
+  * tracing ON  (the default serving config: request ids, spans,
+    Server-Timing, request/stage histograms, slow-request ring)
+  * tracing OFF (--disable-tracing: span accumulation and per-request
+    surfaces suppressed; metrics histograms — an always-on /metrics
+    surface, like TIMES — keep recording in both arms)
+
+Prints one JSON line on stdout; human detail on stderr. Exits nonzero
+when the ON arm lost more than BENCH_OBS_MAX_OVERHEAD_PCT (default 10 —
+a gross-regression gate tolerant of short-run noise; the acceptance
+criterion is <= 2% on a full-length run) or when tracing surfaces are
+missing from responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import sys
+import time
+
+import aiohttp
+
+from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+from bench_util import ensure_native_built, make_1080p_jpeg, pctl
+
+
+async def _arm(options, variants, duration: float, concurrency: int,
+               check_headers: bool):
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(options)
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        lats: list = []
+        errors = [0]
+        async with aiohttp.ClientSession(connector=conn) as session:
+            # warmup outside the timed window (XLA compiles, first fetches)
+            for _ in range(4):
+                async with session.get(next(urls)) as r:
+                    await r.read()
+                    if check_headers:
+                        assert r.headers.get("X-Request-ID"), \
+                            "tracing arm response missing X-Request-ID"
+                        assert "decode;dur=" in r.headers.get(
+                            "Server-Timing", ""), \
+                            "tracing arm response missing Server-Timing spans"
+            deadline = time.monotonic() + duration
+
+            async def worker():
+                while time.monotonic() < deadline:
+                    t0 = time.monotonic()
+                    try:
+                        async with session.get(next(urls)) as res:
+                            await res.read()
+                            if res.status != 200:
+                                errors[0] += 1
+                                continue
+                    except Exception:
+                        errors[0] += 1
+                        continue
+                    lats.append((time.monotonic() - t0) * 1000.0)
+
+            t0 = time.monotonic()
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+            elapsed = time.monotonic() - t0
+        return (len(lats) / elapsed if elapsed else 0.0), lats, errors[0]
+    finally:
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+
+
+def main() -> int:
+    from imaginary_tpu.web.config import ServerOptions
+
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "16"))
+    max_overhead = float(os.environ.get("BENCH_OBS_MAX_OVERHEAD_PCT", "10"))
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+
+    print(f"[obs-bench] cache-off zipf row, tracing on vs off: "
+          f"{concurrency} clients x {duration}s per arm, ABBA-interleaved",
+          file=sys.stderr)
+    # ABBA slice order: sequential whole arms measured +-15% phantom
+    # deltas on a noisy shared host (either sign); interleaving
+    # quarter-slices cancels linear load drift
+    slice_s = max(duration / 2.0, 1.0)
+    totals = {True: [0.0, [], 0], False: [0.0, [], 0]}  # rps-sum, lats, errs
+    for arm_on in (False, True, True, False):
+        rps, lats, errs = asyncio.run(_arm(
+            ServerOptions(enable_url_source=True, trace_enabled=arm_on),
+            variants, slice_s, concurrency, check_headers=arm_on))
+        totals[arm_on][0] += rps
+        totals[arm_on][1].extend(lats)
+        totals[arm_on][2] += errs
+    rps_off, lats_off, err_off = totals[False][0] / 2, totals[False][1], totals[False][2]
+    rps_on, lats_on, err_on = totals[True][0] / 2, totals[True][1], totals[True][2]
+
+    overhead_pct = (100.0 * (rps_off - rps_on) / rps_off) if rps_off else 0.0
+    row = {
+        "metric": "obs_tracing_overhead",
+        "unit": "req/s",
+        "value": round(rps_on, 2),
+        "value_trace_off": round(rps_off, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "p50_ms": pctl(lats_on, 0.50),
+        "p99_ms": pctl(lats_on, 0.99),
+        "p50_ms_trace_off": pctl(lats_off, 0.50),
+        "p99_ms_trace_off": pctl(lats_off, 0.99),
+        "errors": err_on + err_off,
+    }
+    print(json.dumps(row))
+
+    if overhead_pct > max_overhead:
+        print(f"[obs-bench] FAIL: tracing overhead {overhead_pct:.1f}% "
+              f"exceeds {max_overhead:.1f}% gate", file=sys.stderr)
+        return 1
+    print(f"[obs-bench] tracing overhead {overhead_pct:.1f}% "
+          f"({rps_off:.1f} -> {rps_on:.1f} req/s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from imaginary_tpu.obs import trace as obs_trace
+
 
 @dataclasses.dataclass
 class CacheStats:
@@ -159,9 +161,16 @@ class Singleflight:
                     t.exception()  # mark retrieved
 
             task.add_done_callback(_done)
-        else:
-            self.stats.flight_coalesced += 1
-        return await asyncio.shield(task)
+            return await asyncio.shield(task)
+        self.stats.flight_coalesced += 1
+        # a follower's trace shows WHERE the time went: not in its own
+        # pipeline run but waiting on the leader's (the leader's context
+        # owns the shared run's stage spans)
+        tr = obs_trace.current()
+        if tr is not None:
+            tr.annotate(coalesced=True)
+        with obs_trace.span("coalesce_wait"):
+            return await asyncio.shield(task)
 
 
 def _canon(v):

@@ -175,6 +175,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-source-mb", type=float,
                    default=_env_float("IMAGINARY_TPU_CACHE_SOURCE_MB", 32.0),
                    help="remote-source cache byte budget in MB")
+    # observability (imaginary_tpu/obs/): tracing defaults ON (every
+    # response carries X-Request-ID + Server-Timing); /debugz and wide
+    # events default OFF
+    p.add_argument("--disable-tracing", action="store_true",
+                   default=os.environ.get("IMAGINARY_TPU_TRACE", "").lower()
+                   in ("0", "off", "false"),
+                   help="disable per-request span tracing / Server-Timing / "
+                        "wide events (X-Request-ID is still assigned)")
+    p.add_argument("--wide-events", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_WIDE_EVENTS"),
+                   help="emit one structured JSON line per request "
+                        "(op, plan digest, cache outcome, placement, spans)")
+    p.add_argument("--enable-debug", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_DEBUG"),
+                   help="serve /debugz runtime introspection (task dump, "
+                        "executor/cache snapshots, slow-request exemplars, "
+                        "one-shot profiler trigger)")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
     p.add_argument("--coordinator-address", default="",
@@ -267,6 +284,9 @@ def options_from_args(args) -> ServerOptions:
         cache_coalesce=args.cache_coalesce,
         cache_source_ttl=max(0.0, args.cache_source_ttl),
         cache_source_mb=max(0.0, args.cache_source_mb),
+        trace_enabled=not args.disable_tracing,
+        wide_events=args.wide_events,
+        enable_debug=args.enable_debug,
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,
         num_processes=args.num_processes or None,

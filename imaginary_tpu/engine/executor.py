@@ -429,6 +429,41 @@ class Executor:
         with self._owed_lock:
             return self._owed_ms
 
+    def debug_snapshot(self) -> dict:
+        """Point-in-time internals for /debugz: queue/drain occupancy,
+        breaker state, cost-model rates. Reads both locks briefly; safe
+        to call from the event loop at human frequency."""
+        now = time.monotonic()
+        with self._inflight_lock:
+            inflight_groups = self._inflight
+            ds = self._drain_state
+            drain_age_s = round(now - ds[0], 3) if ds is not None else None
+            fetch_gen = self._fetch_gen
+        with self._owed_lock:
+            owed_ms = self._owed_ms
+            breaker_until = self._breaker_open_until
+            consec = self._consec_device_failures
+            rate_keys = len(self._rate_by_key)
+            host_inflight = self._host_inflight
+            host_owed = self._host_owed_mpix
+        return {
+            "queue_depth": self.stats.queue_depth,
+            "inflight_groups": inflight_groups,
+            "drain_in_flight_age_s": drain_age_s,
+            "fetcher_generation": fetch_gen,
+            "owed_ms": round(owed_ms, 3),
+            "breaker_open": now < breaker_until,
+            "breaker_open_for_s": round(max(0.0, breaker_until - now), 3),
+            "consecutive_device_failures": consec,
+            "rate_keys": rate_keys,
+            "device_ms_per_mb": round(self._device_ms_per_mb or 0.0, 3),
+            "drain_floor_ms": round(self._drain_floor_ms or 0.0, 3),
+            "host_ms_per_mpix": round(self._host_ms_per_mpix, 3),
+            "host_inflight": host_inflight,
+            "host_owed_mpix": round(host_owed, 3),
+            "host_gate_free_permits": getattr(self._host_gate, "_value", None),
+        }
+
     def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
         """Enqueue one image; resolves to the output HWC uint8 array.
 

@@ -18,6 +18,9 @@ import threading
 
 import numpy as np
 
+from imaginary_tpu.obs import histogram as _obs_hist
+from imaginary_tpu.obs import trace as _obs_trace
+
 _RING = 2048  # samples kept per stage for percentile estimates
 
 STAGES = (
@@ -32,6 +35,11 @@ STAGES = (
     "encode",       # host codec encode
     "total",        # whole processing call
 )
+
+# Per-stage histogram children resolved once: record() is the hot path
+# (several calls per request) and the stage set is fixed, so the labels()
+# lookup should not be paid per sample.
+_STAGE_HISTS = {s: _obs_hist.STAGE_SECONDS.labels(s) for s in STAGES}
 
 
 class StageTimes:
@@ -52,6 +60,20 @@ class StageTimes:
             ring = self._ring[stage]
             ring[self._pos[stage]] = ms
             self._pos[stage] = (self._pos[stage] + 1) % _RING
+        # Observability fan-out, outside the lock. The histogram is the
+        # aggregatable /metrics surface; the trace attribution turns the
+        # same sample into a per-request span whenever the recording
+        # thread carries a request context (handler tasks and host-pool
+        # workers do; the executor's collector/fetcher threads do not —
+        # their stages are batch-scoped, not request-scoped).
+        hist = _STAGE_HISTS.get(stage)
+        if hist is not None:
+            hist.observe(ms / 1000.0)
+        else:
+            _obs_hist.STAGE_SECONDS.observe((stage,), ms / 1000.0)
+        tr = _obs_trace.current()
+        if tr is not None:
+            tr.add_span(stage, ms)
 
     def snapshot(self) -> dict:
         out = {}
@@ -82,6 +104,28 @@ class StageTimes:
 TIMES = StageTimes()
 
 _profiler_started = False
+_profiler_lock = threading.Lock()
+
+
+def start_profiler(trace_dir: str) -> bool:
+    """Start a jax.profiler trace into an explicit directory. Returns
+    False when a capture is already active (one at a time: jax keeps one
+    global trace session). /debugz/profile uses this for one-shot
+    captures from a live process — no restart needed."""
+    global _profiler_started
+    with _profiler_lock:
+        if _profiler_started:
+            return False
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        _profiler_started = True
+        return True
+
+
+def profiler_active() -> bool:
+    with _profiler_lock:
+        return _profiler_started
 
 
 def maybe_start_profiler() -> bool:
@@ -90,21 +134,17 @@ def maybe_start_profiler() -> bool:
     The trace covers everything until stop_profiler() (or process exit);
     inspect with TensorBoard or xprof. Returns True if a trace started.
     """
-    global _profiler_started
     trace_dir = os.environ.get("IMAGINARY_TPU_PROFILE_DIR")
-    if not trace_dir or _profiler_started:
+    if not trace_dir:
         return False
-    import jax
-
-    jax.profiler.start_trace(trace_dir)
-    _profiler_started = True
-    return True
+    return start_profiler(trace_dir)
 
 
 def stop_profiler() -> None:
     global _profiler_started
-    if _profiler_started:
-        import jax
+    with _profiler_lock:
+        if _profiler_started:
+            import jax
 
-        jax.profiler.stop_trace()
-        _profiler_started = False
+            jax.profiler.stop_trace()
+            _profiler_started = False

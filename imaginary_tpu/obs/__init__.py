@@ -1,0 +1,15 @@
+"""Zero-dependency tracing + telemetry layer (ISSUE 3).
+
+Four small modules, stdlib-only so every layer of the service (cache,
+pipeline, engine, web) can import them without coupling:
+
+  * trace.py     — per-request span trace carried by a contextvar;
+                   X-Request-ID + W3C traceparent identity; Server-Timing.
+  * histogram.py — fixed-bucket cumulative histograms and counters with
+                   Prometheus exposition rendering (the aggregatable
+                   replacement for percentile gauges).
+  * events.py    — one structured JSON "wide event" line per request.
+  * debugz.py    — runtime introspection for the gated /debugz endpoint:
+                   asyncio task dump, slow-request exemplar ring,
+                   one-shot jax.profiler capture.
+"""

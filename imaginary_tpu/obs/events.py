@@ -1,0 +1,41 @@
+"""Wide events: one structured JSON log line per request.
+
+The access log answers "what happened"; the wide event answers "why was
+it slow / wrong" — a single self-contained JSON object per request with
+identity (request/trace id), the operation, plan digest, cache tier
+outcome, placement decision, bytes in/out, status, and every recorded
+span. Off by default (`--wide-events` / IMAGINARY_TPU_WIDE_EVENTS);
+when enabled, lines go to the same stream as the access log so one
+shipper collects both (JSON lines are distinguishable by their leading
+'{').
+
+Schema (stable field names — tests/test_obs.py pins them):
+
+  ts            unix seconds (float)
+  request_id    echoed X-Request-ID
+  trace_id      W3C trace-id (inbound traceparent honored)
+  span_id       this request's span
+  method/route/path/status/http  request facts
+  remote        peer address
+  duration_ms   end-to-end wall time
+  bytes_in/bytes_out             source size / response size
+  op            image operation name (image routes only)
+  plan          16-hex digest of the canonicalized operation+options
+  cache         off | result_miss | result_hit | etag_304
+  coalesced     true when this request waited on another's pipeline run
+  placement     device | host (where the pixels were computed)
+  spans         [{name, start_ms, dur_ms}] full timeline
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def emit(event: dict, out=None) -> None:
+    event.setdefault("ts", round(time.time(), 6))
+    line = json.dumps(event, separators=(",", ":"), default=str)
+    stream = out or sys.stdout
+    stream.write(line + "\n")

@@ -23,6 +23,7 @@ import numpy as np
 
 from imaginary_tpu import codecs
 from imaginary_tpu.engine.timing import TIMES
+from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.codecs import EncodeOptions, YuvPlanes
 from imaginary_tpu.errors import ImageError, new_error
 from imaginary_tpu.imgtype import ImageType, get_image_mime_type, image_type
@@ -153,7 +154,11 @@ def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
         note_placement("device")  # no transform -> no host/device divergence
         return arr
     try:
-        return (runner or chain_mod.run_single)(arr, plan)
+        # the "execute" span covers submit -> result: micro-batch queue
+        # wait + device H2D/compute/drain, OR the host-spill path (whose
+        # host_gate/host_spill sub-spans attribute via the timing hook)
+        with obs_trace.span("execute"):
+            return (runner or chain_mod.run_single)(arr, plan)
     except ImageError:
         raise
     except Exception as e:  # XLA/compile/runtime errors

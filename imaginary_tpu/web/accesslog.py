@@ -3,6 +3,12 @@
 Apache-combined-ish line per request with latency in seconds (4 decimals),
 level-gated: info logs everything, warning logs status >= 400, error logs
 status >= 500 (ref: log.go:88-99).
+
+Two divergences from the r5 format, both log-shipper-driven: the
+timestamp carries the numeric timezone offset (`[04/Aug/2026:12:00:00
++0000]`, Apache combined parity — bare localtime misparses across DST),
+and every line ends with the request's X-Request-ID so a 5xx line joins
+against its trace/wide event.
 """
 
 from __future__ import annotations
@@ -11,6 +17,8 @@ import sys
 import time
 
 from aiohttp import web
+
+from imaginary_tpu.obs import trace as obs_trace
 
 _LEVELS = {"debug": 0, "info": 0, "warning": 400, "error": 500}
 
@@ -26,6 +34,17 @@ _TRUSTED_HOP_TOKEN: str = ""
 def set_trusted_hop_token(token: str) -> None:
     global _TRUSTED_HOP_TOKEN
     _TRUSTED_HOP_TOKEN = token
+
+
+def _apache_timestamp() -> str:
+    """`04/Aug/2026:12:00:00 +0000` — localtime WITH its UTC offset, the
+    Apache combined format every log shipper's CLF grammar expects."""
+    lt = time.localtime()
+    off = lt.tm_gmtoff if lt.tm_gmtoff is not None else 0
+    sign = "+" if off >= 0 else "-"
+    off = abs(off)
+    return (time.strftime("%d/%b/%Y:%H:%M:%S", lt)
+            + f" {sign}{off // 3600:02d}{(off % 3600) // 60:02d}")
 
 
 def access_log_middleware(level: str = "info", out=None):
@@ -46,7 +65,9 @@ def access_log_middleware(level: str = "info", out=None):
         finally:
             if status >= threshold:
                 elapsed = time.monotonic() - start
-                ts = time.strftime("%d/%b/%Y %H:%M:%S", time.localtime())
+                ts = _apache_timestamp()
+                tr = obs_trace.current()
+                rid = tr.request_id if tr is not None else "-"
                 peer = request.remote or "-"
                 httpv = f"{request.version.major}.{request.version.minor}"
                 if (
@@ -61,7 +82,7 @@ def access_log_middleware(level: str = "info", out=None):
                 line = (
                     f'{peer} - - [{ts}] "{request.method} {request.path_qs} '
                     f'HTTP/{httpv}" '
-                    f"{status} {length} {elapsed:.4f}\n"
+                    f"{status} {length} {elapsed:.4f} {rid}\n"
                 )
                 stream.write(line)
         return resp

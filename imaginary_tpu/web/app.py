@@ -24,7 +24,7 @@ from imaginary_tpu.web.handlers import (
     health_controller,
     index_controller,
 )
-from imaginary_tpu.web.middleware import build_middlewares
+from imaginary_tpu.web.middleware import build_middlewares, trace_middleware
 
 
 def tune_gc_for_serving() -> None:
@@ -43,8 +43,13 @@ def tune_gc_for_serving() -> None:
 
 
 def create_app(o: ServerOptions, log_stream=None) -> web.Application:
+    # trace middleware is OUTERMOST: it assigns request identity and
+    # installs the contextvar trace before the access log (which reads
+    # the id) and everything inside it runs
     app = web.Application(
-        middlewares=[access_log_middleware(o.log_level, log_stream)] + build_middlewares(o),
+        middlewares=[trace_middleware(o, log_stream),
+                     access_log_middleware(o.log_level, log_stream)]
+        + build_middlewares(o),
         client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
     )
     service = ImageService(o)
@@ -66,6 +71,12 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     add(prefix + "/form", partial(_form, o), methods=("GET",))
     add(prefix + "/health", partial(_health, service), methods=("GET",))
     add(prefix + "/metrics", partial(_metrics, service), methods=("GET",))
+    # gated runtime introspection (404 unless --enable-debug; NOT in
+    # PUBLIC_PATHS, so an API key — when set — is required like any
+    # image route)
+    add(prefix + "/debugz", partial(_debugz, service, o), methods=("GET",))
+    add(prefix + "/debugz/profile", partial(_debugz_profile, o),
+        methods=("GET",))
 
     for name in ALL_OPERATIONS:
         route = "/" + (name.lower() if name == "watermarkImage" else name)
@@ -98,6 +109,29 @@ async def _metrics(service, request):
 
 async def _image(service, name, request):
     return await service.handle(request, name)
+
+
+async def _debugz(service, o, request):
+    if not o.enable_debug:
+        from imaginary_tpu.errors import ErrNotFound
+        from imaginary_tpu.web.middleware import error_response
+
+        return error_response(request, ErrNotFound, o)
+    from imaginary_tpu.obs.debugz import debug_payload
+
+    return web.json_response(debug_payload(service))
+
+
+async def _debugz_profile(o, request):
+    if not o.enable_debug:
+        from imaginary_tpu.errors import ErrNotFound
+        from imaginary_tpu.web.middleware import error_response
+
+        return error_response(request, ErrNotFound, o)
+    from imaginary_tpu.obs.debugz import profile_capture
+
+    body, status = await profile_capture(request.query)
+    return web.json_response(body, status=status)
 
 
 def _pin_groups(ctx) -> bool:

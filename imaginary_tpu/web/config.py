@@ -95,6 +95,19 @@ class ServerOptions:
     # its own byte budget
     cache_source_ttl: float = 0.0
     cache_source_mb: float = 32.0
+    # --- observability (imaginary_tpu/obs/) ---------------------------------
+    # Per-request span tracing (X-Request-ID is ALWAYS assigned/echoed;
+    # this gates span accumulation, Server-Timing, wide events, and the
+    # slow-request exemplar ring). On by default; the off switch exists
+    # for A-B overhead measurement (bench_obs.py) and emergencies.
+    trace_enabled: bool = True
+    # One structured JSON line per request (obs/events.py schema), written
+    # to the access-log stream. Off by default.
+    wide_events: bool = False
+    # /debugz runtime introspection (task dump, executor/cache snapshots,
+    # slow-request exemplars, one-shot profiler). Off by default: it is an
+    # information surface an internet-facing deployment must opt into.
+    enable_debug: bool = False
     # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
     distributed: bool = False
     coordinator_address: str = ""

@@ -11,6 +11,8 @@ max-allowed-resolution guard, and `-return-size` headers.
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import hashlib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -31,6 +33,7 @@ from imaginary_tpu.errors import (
     ImageError,
     new_error,
 )
+from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.imgtype import (
     determine_image_type,
     get_image_mime_type,
@@ -119,6 +122,9 @@ class ImageService:
 
     async def handle(self, request: web.Request, op_name: str) -> web.StreamResponse:
         o = self.options
+        tr = obs_trace.current()
+        if tr is not None:
+            tr.annotate(op=op_name)
         try:
             if o.enable_url_signature:
                 check_url_signature(request, o)
@@ -129,9 +135,12 @@ class ImageService:
                 # latency + fast 503s, not an unbounded queue (GCRA bounds
                 # the rate; this bounds what a burst can pile up)
                 raise new_error("Server queue is full, retry later", 503)
-            buf = await self._get_source_image(request)
+            with obs_trace.span("fetch"):
+                buf = await self._get_source_image(request)
             if not buf:
                 raise ErrEmptyBody
+            if tr is not None:
+                tr.annotate(bytes_in=len(buf))
             return await self._process_and_respond(request, op_name, buf)
         except ImageError as e:
             return error_response(request, e, o)
@@ -193,23 +202,45 @@ class ImageService:
             digest = cache_mod.source_digest(buf)
         if caches.keyed:
             key = cache_mod.request_key(digest, op_name, opts)
+
+        tr = obs_trace.current()
+        if tr is not None and tr.enabled:
+            # plan digest: op x negotiated output type x sorted query with
+            # source-identifying params excluded — a GROUPING key for wide
+            # events ("which transformation shape was slow"), cheap by
+            # construction (the full options canonicalization costs ~50us
+            # per call, measured; this is the per-request hot path)
+            qs = tuple(sorted(
+                (k, v) for k, v in request.query.items()
+                if k not in ("url", "file", "sign")
+            ))
+            tr.annotate(plan=hashlib.sha256(
+                repr((op_name, opts.type, qs)).encode()).hexdigest()[:16],
+                cache="off")
         if caches.result.enabled and key is not None:
-            etag = cache_mod.strong_etag(key)
-            if request.method == "GET" and cache_mod.etag_matches(
-                request.headers.get("If-None-Match", ""), etag
-            ):
-                # conditional GET answered before the pipeline runs
-                caches.stats.etag_304 += 1
-                headers = {"ETag": etag}
-                if vary:
-                    headers["Vary"] = vary
-                return web.Response(status=304, headers=headers)
-            hit = caches.result.get(key)
+            with obs_trace.span("cache_lookup"):
+                etag = cache_mod.strong_etag(key)
+                if request.method == "GET" and cache_mod.etag_matches(
+                    request.headers.get("If-None-Match", ""), etag
+                ):
+                    # conditional GET answered before the pipeline runs
+                    caches.stats.etag_304 += 1
+                    if tr is not None:
+                        tr.annotate(cache="etag_304")
+                    headers = {"ETag": etag}
+                    if vary:
+                        headers["Vary"] = vary
+                    return web.Response(status=304, headers=headers)
+                hit = caches.result.get(key)
             if hit is not None:
                 caches.stats.result_hits += 1
+                if tr is not None:
+                    tr.annotate(cache="result_hit")
                 out, placement = hit
                 return self._build_response(out, placement, vary, etag, o)
             caches.stats.result_misses += 1
+            if tr is not None:
+                tr.annotate(cache="result_miss")
 
         async def produce():
             wm_rgba = await self._prefetch_watermark(request, op_name, opts)
@@ -231,8 +262,14 @@ class ImageService:
             # until --max-queue-ms latched shut.
             with self._inflight_lock:
                 self._inflight += 1
-            fut = self.pool.submit(self._process_sync, op_name, buf, opts,
-                                   wm_rgba, meta, digest)
+            # copy_context() carries the contextvar trace into the worker
+            # thread: stage timings recorded there (decode/encode/
+            # host_spill via engine/timing.py) attribute to THIS request.
+            # For a coalesced group the leader's context rides along —
+            # the shared run's spans land in the leader's trace.
+            ctx = contextvars.copy_context()
+            fut = self.pool.submit(ctx.run, self._process_sync, op_name, buf,
+                                   opts, wm_rgba, meta, digest)
             fut.add_done_callback(self._release_if_cancelled)
             return await asyncio.wrap_future(fut)
 
@@ -251,6 +288,8 @@ class ImageService:
         except Exception as e:
             raise new_error("Error processing image: " + str(e), 400) from None
 
+        if tr is not None:
+            tr.annotate(placement=placement)
         if caches.result.enabled and key is not None:
             # placement rides along so a replayed response carries the
             # same X-Imaginary-Backend facts as the run that produced it

@@ -380,6 +380,15 @@ class H2Protocol(asyncio.Protocol):
     # -- request forwarding ----------------------------------------------------
 
     async def _handle(self, stream_id: int, st: _Stream):
+        # Request identity is assigned at the EDGE: when the client sent
+        # no X-Request-ID, the terminator mints one and forwards it, so
+        # the app echoes the same id the terminator will attach to a
+        # hop-failure 502 — every h2 response carries the id either way.
+        from imaginary_tpu.obs.trace import new_request_id, sanitize_request_id
+
+        rid = sanitize_request_id(next(
+            (v for n, v in st.headers if n.lower() == "x-request-id"), ""
+        )) or new_request_id()
         try:
             _dbg(f"dispatch sid={stream_id} body={len(st.body)}")
             pseudo = {n: v for n, v in st.headers if n.startswith(":")}
@@ -405,6 +414,11 @@ class H2Protocol(asyncio.Protocol):
                 headers.append(("Cookie", "; ".join(cookies)))
             if authority:
                 headers.append(("Host", authority))
+            # client-sent ids were forwarded above only if sane; replace
+            # with the sanitized/minted one the 502 path also uses
+            headers = [(n, v) for n, v in headers
+                       if n.lower() != "x-request-id"]
+            headers.append(("X-Request-ID", rid))
             headers.append(("X-Forwarded-For", self._peer))
             headers.append(("X-Forwarded-Proto", "https"))
             headers.append(("X-Forwarded-HTTP-Version", "2.0"))
@@ -431,11 +445,13 @@ class H2Protocol(asyncio.Protocol):
         except asyncio.CancelledError:
             raise
         except Exception:
-            # loopback hop failed: the stream gets a bare 502
+            # loopback hop failed: the stream gets a bare 502 (which
+            # still carries the request id, for log correlation)
             try:
                 self._submit_response(
                     stream_id, st,
-                    [(":status", "502"), ("content-length", "0")], b"",
+                    [(":status", "502"), ("x-request-id", rid),
+                     ("content-length", "0")], b"",
                 )
             except Exception:
                 self._abort()

@@ -1,57 +1,120 @@
 """Prometheus text-format /metrics endpoint.
 
 ABOVE-REFERENCE: the reference has no Prometheus surface (SURVEY.md
-section 5.5 — operators are pointed at a fluentd log recipe). This
-renders the SAME numbers /health serves, in exposition format 0.0.4, so
-the fleet can be scraped without a sidecar. The mapping is mechanical:
-health's camelCase keys become snake_case gauges under the
-`imaginary_tpu_` namespace, executor counters become
-`imaginary_tpu_executor_*`, and per-stage latency percentiles become
-labeled `imaginary_tpu_stage_ms{stage=...,q=...}` gauges.
+section 5.5 — operators are pointed at a fluentd log recipe). Two layers
+of exposition, both format-0.0.4-strict (`# HELP`/`# TYPE` per family,
+label values escaped, families grouped — promtool-parseable, pinned by
+tests/test_obs.py's strict parser):
+
+  1. The /health mirror: the SAME numbers /health serves, as gauges and
+     counters under the `imaginary_tpu_` namespace (executor counters,
+     cache tier counters, per-stage latency percentile gauges — the
+     human-readable view; percentile gauges cannot be aggregated across
+     replicas, which is why layer 2 exists).
+  2. The obs registry (imaginary_tpu/obs/histogram.py): proper
+     fixed-bucket cumulative histograms (`imaginary_tpu_request_duration_seconds`,
+     `imaginary_tpu_stage_duration_seconds{stage=}`) plus RED counters
+     per route x status class — the fleet-aggregatable surface
+     (`histogram_quantile(0.99, sum by (le) (rate(..._bucket[5m])))`).
 """
 
 from __future__ import annotations
 
 import re
 
+from imaginary_tpu.obs.histogram import REGISTRY, escape_label_value
+
+# Occupancy/level metrics mirrored from /health; everything else in the
+# executor/cache blocks is a monotonically-increasing counter.
+_EXEC_GAUGES = {
+    "avg_batch", "avg_group", "max_group", "queue_depth",
+    "compile_cache_size", "device_ms_per_mb", "host_ms_per_mpix",
+    "host_inflight", "host_owed_mpix", "host_spill_p50_ms",
+    "host_spill_p99_ms",
+}
+_CACHE_GAUGES = {
+    "result_items", "result_bytes", "frame_items", "frame_bytes",
+    "source_items", "source_bytes",
+}
+
 
 def _snake(name: str) -> str:
     return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", name).lower()
 
 
-def _emit(lines: list, name: str, value, labels: str = "") -> None:
-    if isinstance(value, bool):
-        value = int(value)
-    if not isinstance(value, (int, float)):
-        return
-    lines.append(f"{name}{{{labels}}} {value}" if labels else f"{name} {value}")
+class _Exposition:
+    """Line accumulator that emits each family's `# HELP`/`# TYPE` header
+    exactly once, before its first sample."""
+
+    def __init__(self):
+        self.lines: list = []
+        self._seen: set = set()
+
+    def emit(self, name: str, value, labels: str = "",
+             mtype: str = "gauge", help_text: str = "") -> None:
+        if isinstance(value, bool):
+            value = int(value)
+        if not isinstance(value, (int, float)):
+            return
+        if name not in self._seen:
+            self._seen.add(name)
+            if help_text:
+                self.lines.append(f"# HELP {name} {help_text}")
+            self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.append(
+            f"{name}{{{labels}}} {value}" if labels else f"{name} {value}"
+        )
 
 
 def render_metrics(stats: dict) -> str:
-    """Health-stats dict -> Prometheus exposition text."""
-    lines: list = []
+    """Health-stats dict + obs registry -> Prometheus exposition text."""
+    x = _Exposition()
+    # deferred so each family's samples stay contiguous (the format
+    # requires grouping; the stage loop would otherwise interleave the
+    # stage_ms and stage_total families)
+    stage_ms: list = []
+    stage_total: list = []
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
             for k, v in value.items():
-                _emit(lines, f"imaginary_tpu_executor_{_snake(k)}", v)
+                mtype = "gauge" if k in _EXEC_GAUGES else "counter"
+                x.emit(f"imaginary_tpu_executor_{_snake(k)}", v, mtype=mtype,
+                       help_text=f"Executor {k.replace('_', ' ')} (see /health).")
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
             for k, v in value.items():
-                _emit(lines, f"imaginary_tpu_cache_{_snake(k)}", v)
+                mtype = "gauge" if k in _CACHE_GAUGES else "counter"
+                x.emit(f"imaginary_tpu_cache_{_snake(k)}", v, mtype=mtype,
+                       help_text=f"Cache {k.replace('_', ' ')} (see /health).")
         elif key == "stageTimesMs" and isinstance(value, dict):
             for stage, pcts in value.items():
+                lab = escape_label_value(stage)
                 for q, v in pcts.items():
                     if q == "count":
                         # dimensionless counter: its own series, never
                         # mixed into the milliseconds gauge family
-                        _emit(lines, "imaginary_tpu_stage_total", v,
-                              f'stage="{stage}"')
+                        stage_total.append((f'stage="{lab}"', v))
                     else:
-                        _emit(lines, "imaginary_tpu_stage_ms", v,
-                              f'stage="{stage}",q="{_snake(q).replace("_ms", "")}"')
+                        qlab = escape_label_value(
+                            _snake(q).replace("_ms", ""))
+                        stage_ms.append(
+                            (f'stage="{lab}",q="{qlab}"', v))
         elif key == "backend":
-            _emit(lines, "imaginary_tpu_backend_info", 1, f'backend="{value}"')
+            x.emit("imaginary_tpu_backend_info", 1,
+                   f'backend="{escape_label_value(value)}"',
+                   help_text="Active JAX backend (value is always 1).")
         else:
-            _emit(lines, f"imaginary_tpu_{_snake(key)}", value)
-    return "\n".join(lines) + "\n"
+            x.emit(f"imaginary_tpu_{_snake(key)}", value,
+                   help_text=f"{key} (see /health).")
+    for labels, v in stage_total:
+        x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
+               help_text="Samples recorded per pipeline stage.")
+    for labels, v in stage_ms:
+        x.emit("imaginary_tpu_stage_ms", v, labels,
+               help_text="Per-stage latency percentile gauges (single-"
+                         "process window; use the _duration_seconds "
+                         "histograms for fleet aggregation).")
+    # layer 2: request/stage duration histograms + RED counters
+    x.lines.extend(REGISTRY.render_lines())
+    return "\n".join(x.lines) + "\n"

@@ -18,6 +18,11 @@ from urllib.parse import urlencode
 
 from aiohttp import web
 
+from imaginary_tpu.obs import events as obs_events
+from imaginary_tpu.obs import histogram as obs_hist
+from imaginary_tpu.obs import trace as obs_trace
+from imaginary_tpu.obs.debugz import SLOW as obs_slow
+
 from imaginary_tpu.errors import (
     ErrGetMethodNotAllowed,
     ErrInvalidAPIKey,
@@ -93,6 +98,79 @@ def error_response(request: web.Request, err: ImageError, o: ServerOptions) -> w
         status=err.http_code(),
         content_type="application/json",
     )
+
+
+def _route_label(request: web.Request) -> str:
+    """Bounded RED-counter route label: the matched route's canonical
+    pattern (a fixed table), never the raw path — an unmatched path (404
+    scans) must not mint a metric series per URL."""
+    try:
+        canonical = request.match_info.route.resource.canonical
+    except AttributeError:
+        return "unmatched"
+    return canonical or "unmatched"
+
+
+def trace_middleware(o: ServerOptions, events_out=None):
+    """Outermost middleware: request identity + trace lifecycle.
+
+    Assigns/propagates X-Request-ID and W3C traceparent, installs the
+    contextvar-carried RequestTrace every inner layer records spans into
+    (access log included — it runs inside this and reads the id), then on
+    the way out: echoes X-Request-ID, emits Server-Timing, observes the
+    request-duration histogram + RED counters, feeds the slow-request
+    exemplar ring, and (opt-in) writes the JSON wide event."""
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        rid = obs_trace.sanitize_request_id(
+            request.headers.get("X-Request-ID", "")
+        ) or obs_trace.new_request_id()
+        tr = obs_trace.RequestTrace(
+            rid,
+            traceparent=request.headers.get("traceparent", ""),
+            enabled=o.trace_enabled,
+        )
+        token = obs_trace.activate(tr)
+        t0 = time.monotonic()
+        status = 500  # a non-HTTP exception books as a 500
+        resp = None
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            e.headers["X-Request-ID"] = tr.request_id
+            raise
+        finally:
+            obs_trace.deactivate(token)
+            elapsed = time.monotonic() - t0
+            route = _route_label(request)
+            obs_hist.REQUEST_SECONDS.observe(elapsed)
+            obs_hist.REQUESTS_TOTAL.inc((route, f"{status // 100}xx"))
+            if resp is not None:
+                resp.headers["X-Request-ID"] = tr.request_id
+                if tr.enabled:
+                    st = tr.server_timing()
+                    if st:
+                        resp.headers["Server-Timing"] = st
+            if tr.enabled:
+                event = tr.to_event(
+                    method=request.method,
+                    route=route,
+                    path=request.path_qs,
+                    status=status,
+                    remote=request.remote or "-",
+                    duration_ms=round(elapsed * 1000.0, 3),
+                    bytes_out=(resp.content_length or 0)
+                    if resp is not None else 0,
+                )
+                obs_slow.note(event)
+                if o.wide_events:
+                    obs_events.emit(event, events_out)
+
+    return mw
 
 
 def build_middlewares(o: ServerOptions) -> list:

@@ -29,6 +29,7 @@ from imaginary_tpu.errors import (
     ImageError,
     new_error,
 )
+from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.version import Version
 from imaginary_tpu.web.config import ServerOptions
 
@@ -163,6 +164,15 @@ class HTTPImageSource:
                 caches.stats.source_hits += 1
                 return hit
             caches.stats.source_misses += 1
+        # Trace propagation to the origin, injected AFTER the cache key
+        # derived: the per-request traceparent/X-Request-ID must never
+        # partition the source cache (a unique header per request would
+        # turn every hot-URL fetch into a miss).
+        tr = obs_trace.current()
+        if tr is not None and tr.enabled:
+            headers = dict(headers)
+            headers["traceparent"] = tr.outbound_traceparent()
+            headers["X-Request-ID"] = tr.request_id
         max_size = limit or self.options.max_allowed_size
         if self.options.max_allowed_size > 0 and limit is None:
             await self._check_size(sess, url, headers)

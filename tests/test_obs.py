@@ -1,0 +1,570 @@
+"""Observability layer (imaginary_tpu/obs/ + its web/engine threading).
+
+Covers the ISSUE 3 acceptance list: X-Request-ID / traceparent
+propagation (inbound passthrough, generation, outbound forwarding to
+origins), histogram bucket monotonicity + _sum/_count consistency,
+Server-Timing response header contents, /debugz gating (404 when
+disabled, auth posture when enabled), the wide-event JSON schema, and a
+STRICT Prometheus exposition-format parse of /metrics (HELP/TYPE per
+family, grouped samples, escaped labels, no duplicate series).
+"""
+
+import asyncio
+import io
+import json
+import re
+import secrets
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from imaginary_tpu.obs import debugz as obs_debugz
+from imaginary_tpu.obs import histogram as obs_hist
+from imaginary_tpu.obs import trace as obs_trace
+from imaginary_tpu.web.config import ServerOptions
+from tests.conftest import fixture_bytes
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+def run(options, fn, origin_handler=None, log_stream=None):
+    """test_cache.py's harness: fn(client, origin_url, app) against a
+    fresh app; optional captured log stream (access log + wide events)."""
+
+    async def runner():
+        from imaginary_tpu.web.app import create_app
+
+        origin_url = None
+        origin = None
+        if origin_handler is not None:
+            oapp = web.Application()
+            oapp.router.add_route("*", "/{tail:.*}", origin_handler)
+            origin = TestServer(oapp)
+            await origin.start_server()
+            origin_url = f"http://127.0.0.1:{origin.port}"
+
+        app = create_app(options, log_stream=log_stream or io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, origin_url, app)
+        finally:
+            await client.close()
+            if origin is not None:
+                await origin.close()
+
+    asyncio.run(runner())
+
+
+def jpg() -> bytes:
+    return fixture_bytes("imaginary.jpg")
+
+
+# --- trace unit behavior ------------------------------------------------------
+
+class TestTraceUnit:
+    def test_traceparent_inbound_parsed(self):
+        tid, sid = secrets.token_hex(16), secrets.token_hex(8)
+        tr = obs_trace.RequestTrace("rid", f"00-{tid}-{sid}-01")
+        assert tr.trace_id == tid
+        assert tr.parent_span_id == sid
+        assert tr.traceparent().startswith(f"00-{tid}-")
+        assert tr.traceparent().endswith("-01")
+
+    def test_malformed_traceparent_starts_fresh_trace(self):
+        for bad in ("", "garbage", "00-xyz-abc-01", "00-" + "0" * 31 + "-" +
+                    "0" * 16 + "-01"):
+            tr = obs_trace.RequestTrace("rid", bad)
+            assert re.fullmatch(r"[0-9a-f]{32}", tr.trace_id)
+            assert tr.parent_span_id == ""
+
+    def test_outbound_traceparent_same_trace_new_span(self):
+        tr = obs_trace.RequestTrace("rid")
+        a, b = tr.outbound_traceparent(), tr.outbound_traceparent()
+        assert a != b
+        assert a.split("-")[1] == b.split("-")[1] == tr.trace_id
+
+    def test_sanitize_request_id(self):
+        assert obs_trace.sanitize_request_id("abc-123_X.y") == "abc-123_X.y"
+        assert obs_trace.sanitize_request_id("") == ""
+        assert obs_trace.sanitize_request_id("evil\nheader: x") == ""
+        assert obs_trace.sanitize_request_id("x" * 200) == ""
+
+    def test_server_timing_aggregates_repeated_spans(self):
+        tr = obs_trace.RequestTrace("rid")
+        tr.add_span("decode", 2.0)
+        tr.add_span("decode", 3.0)
+        tr.add_span("encode", 1.5)
+        st = tr.server_timing()
+        assert "decode;dur=5.00" in st
+        assert "encode;dur=1.50" in st
+
+    def test_span_context_manager_needs_active_trace(self):
+        # no active trace: pure no-op, no error
+        with obs_trace.span("x"):
+            pass
+        tr = obs_trace.RequestTrace("rid")
+        token = obs_trace.activate(tr)
+        try:
+            with obs_trace.span("work"):
+                pass
+        finally:
+            obs_trace.deactivate(token)
+        assert [s.name for s in tr.spans] == ["work"]
+
+    def test_disabled_trace_records_nothing(self):
+        tr = obs_trace.RequestTrace("rid", enabled=False)
+        tr.add_span("decode", 2.0)
+        tr.annotate(op="resize")
+        assert tr.spans == [] and tr.fields == {}
+
+
+# --- histogram unit behavior --------------------------------------------------
+
+class TestHistogramUnit:
+    def test_bucket_monotonicity_and_sum_count(self):
+        h = obs_hist.Histogram(buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0, 0.05):
+            h.observe(v)
+        cumulative, total_sum, total_count = h.snapshot()
+        assert cumulative == [1, 3, 4, 5]  # nondecreasing, +Inf == count
+        assert total_count == 5
+        assert abs(total_sum - 5.605) < 1e-9
+        assert all(a <= b for a, b in zip(cumulative, cumulative[1:]))
+
+    def test_boundary_value_lands_in_its_le_bucket(self):
+        h = obs_hist.Histogram(buckets=(0.1, 1.0))
+        h.observe(0.1)  # le="0.1" is INCLUSIVE (Prometheus semantics)
+        cumulative, _, _ = h.snapshot()
+        assert cumulative[0] == 1
+
+    def test_label_escaping(self):
+        assert obs_hist.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+    def test_vec_series_bound(self):
+        vec = obs_hist.CounterVec(("k",))
+        for i in range(obs_hist._MAX_SERIES + 10):
+            vec.inc((f"v{i}",))
+        assert len(vec.items()) <= obs_hist._MAX_SERIES + 1  # + overflow
+
+
+# --- request identity over HTTP ----------------------------------------------
+
+class TestRequestIdentity:
+    def test_request_id_generated_on_every_response(self):
+        async def fn(client, _origin, _app):
+            for path in ("/health", "/metrics", "/bogus-route"):
+                res = await client.get(path)
+                rid = res.headers.get("X-Request-ID")
+                assert rid and re.fullmatch(r"[0-9a-f]{32}", rid)
+
+        run(ServerOptions(), fn)
+
+    def test_inbound_request_id_passthrough(self):
+        async def fn(client, _origin, _app):
+            res = await client.get("/health",
+                                   headers={"X-Request-ID": "my-id-123"})
+            assert res.headers["X-Request-ID"] == "my-id-123"
+            # hostile ids are regenerated, not echoed
+            res = await client.get("/health",
+                                   headers={"X-Request-ID": "x y\tz"})
+            assert re.fullmatch(r"[0-9a-f]{32}",
+                                res.headers["X-Request-ID"])
+
+        run(ServerOptions(), fn)
+
+    def test_outbound_fetch_forwards_trace_headers(self):
+        seen = []
+
+        async def origin(request):
+            seen.append(dict(request.headers))
+            return web.Response(body=jpg(), content_type="image/jpeg")
+
+        tid = secrets.token_hex(16)
+
+        async def fn(client, origin_url, _app):
+            res = await client.get(
+                f"/resize?width=100&url={origin_url}/img.jpg",
+                headers={"traceparent": f"00-{tid}-{'ab' * 8}-01",
+                         "X-Request-ID": "req-42"},
+            )
+            assert res.status == 200
+            assert res.headers["X-Request-ID"] == "req-42"
+            assert len(seen) == 1
+            h = seen[0]
+            assert h["X-Request-ID"] == "req-42"
+            # same trace continues; the hop gets its own child span id
+            parts = h["traceparent"].split("-")
+            assert parts[1] == tid and parts[2] != "ab" * 8
+
+        run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
+
+    def test_trace_headers_do_not_partition_source_cache(self):
+        hits = [0]
+
+        async def origin(request):
+            hits[0] += 1
+            return web.Response(body=jpg(), content_type="image/jpeg")
+
+        async def fn(client, origin_url, app):
+            for _ in range(3):  # unique traceparent per request
+                res = await client.get(
+                    f"/resize?width=100&url={origin_url}/img.jpg")
+                assert res.status == 200
+            assert hits[0] == 1  # origin fetched once despite 3 traces
+            assert app["service"].caches.stats.source_hits == 2
+
+        run(ServerOptions(enable_url_source=True, cache_source_ttl=60.0),
+            fn, origin_handler=origin)
+
+
+# --- Server-Timing ------------------------------------------------------------
+
+class TestServerTiming:
+    def test_image_response_carries_stage_timings(self):
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            st = res.headers.get("Server-Timing", "")
+            for name in ("fetch", "decode", "execute", "encode", "total"):
+                assert re.search(rf"{name};dur=\d+(\.\d+)?", st), (name, st)
+
+        run(ServerOptions(), fn)
+
+    def test_tracing_disabled_still_sets_request_id(self):
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            assert "Server-Timing" not in res.headers
+            assert re.fullmatch(r"[0-9a-f]{32}",
+                                res.headers["X-Request-ID"])
+
+        run(ServerOptions(trace_enabled=False), fn)
+
+
+# --- wide events --------------------------------------------------------------
+
+def _wide_events(stream: io.StringIO) -> list:
+    return [json.loads(ln) for ln in stream.getvalue().splitlines()
+            if ln.startswith("{")]
+
+
+class TestWideEvents:
+    def test_schema_and_5xx_correlation(self):
+        stream = io.StringIO()
+
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            rid_ok = res.headers["X-Request-ID"]
+            res = await client.post("/resize?width=100", data=b"notanimage")
+            rid_bad = res.headers["X-Request-ID"]
+            assert res.status >= 400
+
+            events = _wide_events(stream)
+            assert len(events) == 2
+            ok = next(e for e in events if e["status"] == 200)
+            for field in ("ts", "request_id", "trace_id", "span_id",
+                          "method", "route", "path", "status", "remote",
+                          "duration_ms", "bytes_in", "bytes_out", "op",
+                          "plan", "cache", "placement", "spans"):
+                assert field in ok, field
+            assert ok["request_id"] == rid_ok
+            assert ok["op"] == "resize"
+            assert ok["cache"] == "off"
+            assert ok["placement"] in ("device", "host")
+            assert ok["bytes_in"] > 0 and ok["bytes_out"] > 0
+            names = [s["name"] for s in ok["spans"]]
+            assert "decode" in names and "encode" in names
+            assert all(s["dur_ms"] >= 0 and "start_ms" in s
+                       for s in ok["spans"])
+            # the error event still carries the response's id (the 5xx
+            # correlation contract; 4xx pins the same code path)
+            bad = next(e for e in events if e["status"] >= 400)
+            assert bad["request_id"] == rid_bad
+
+        run(ServerOptions(wide_events=True), fn, log_stream=stream)
+
+    def test_access_log_line_and_wide_event_share_id(self):
+        stream = io.StringIO()
+
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            rid = res.headers["X-Request-ID"]
+            text = stream.getvalue()
+            log_line = next(ln for ln in text.splitlines()
+                            if not ln.startswith("{"))
+            assert log_line.rstrip().endswith(rid)
+            assert _wide_events(stream)[0]["request_id"] == rid
+
+        run(ServerOptions(wide_events=True), fn, log_stream=stream)
+
+    def test_cache_and_coalesce_outcomes_recorded(self):
+        stream = io.StringIO()
+
+        async def fn(client, _origin, _app):
+            for _ in range(2):
+                res = await client.post("/resize?width=100", data=jpg())
+                assert res.status == 200
+            events = _wide_events(stream)
+            assert events[0]["cache"] == "result_miss"
+            assert events[1]["cache"] == "result_hit"
+
+        run(ServerOptions(wide_events=True, cache_result_mb=16.0), fn,
+            log_stream=stream)
+
+
+# --- strict exposition-format parser -----------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? "
+    r"(-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\+?Inf|NaN))$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\["\\n])*)"')
+
+
+def parse_exposition_strict(text: str):
+    """Parse Prometheus text format 0.0.4 the way a scraper does; raise
+    AssertionError on any violation: samples before their family's TYPE,
+    duplicate TYPE, malformed labels, duplicate series."""
+    types: dict = {}
+    samples: list = []
+    seen_series: set = set()
+    assert text.endswith("\n")
+    for ln in text.splitlines():
+        assert ln.strip(), "blank line in exposition"
+        if ln.startswith("# TYPE "):
+            _, _, rest = ln.partition("# TYPE ")
+            name, mtype = rest.split(" ", 1)
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), ln
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = mtype
+        elif ln.startswith("# HELP "):
+            continue
+        elif ln.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(ln)
+            assert m, f"malformed sample line: {ln!r}"
+            name, raw_labels, value = m.group(1), m.group(2), m.group(3)
+            labels = {}
+            if raw_labels:
+                consumed = 0
+                for lm in _LABEL_RE.finditer(raw_labels):
+                    labels[lm.group(1)] = lm.group(2)
+                    consumed += len(lm.group(0))
+                stripped = raw_labels.replace(",", "")
+                assert consumed == len(stripped), \
+                    f"unparseable labels: {raw_labels!r}"
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and types.get(base) == "histogram":
+                    family = base
+            assert family in types, f"sample before TYPE: {ln!r}"
+            series = (name, tuple(sorted(labels.items())))
+            assert series not in seen_series, f"duplicate series: {series}"
+            seen_series.add(series)
+            samples.append((name, labels, float(value.replace("Inf", "inf"))))
+    return types, samples
+
+
+def check_histograms(types, samples):
+    """Every histogram family: buckets cumulative-monotone in le order,
+    +Inf bucket == _count, _sum present."""
+    for family, mtype in types.items():
+        if mtype != "histogram":
+            continue
+        groups: dict = {}
+        for name, labels, value in samples:
+            if name == f"{family}_bucket":
+                rest = tuple(sorted((k, v) for k, v in labels.items()
+                                    if k != "le"))
+                groups.setdefault(rest, []).append(
+                    (float(labels["le"].replace("+Inf", "inf")), value))
+        assert groups, f"histogram {family} emitted no buckets"
+        counts = {tuple(sorted(labels.items())): value
+                  for name, labels, value in samples
+                  if name == f"{family}_count"}
+        sums = {tuple(sorted(labels.items())): value
+                for name, labels, value in samples
+                if name == f"{family}_sum"}
+        for rest, buckets in groups.items():
+            buckets.sort()
+            values = [v for _, v in buckets]
+            assert all(a <= b for a, b in zip(values, values[1:])), \
+                f"{family}{dict(rest)}: non-monotone buckets {values}"
+            assert buckets[-1][0] == float("inf")
+            assert rest in counts and counts[rest] == buckets[-1][1], \
+                f"{family}{dict(rest)}: +Inf bucket != _count"
+            assert rest in sums
+
+
+class TestMetricsExposition:
+    def test_strict_parse_and_histogram_consistency(self):
+        async def fn(client, _origin, _app):
+            for _ in range(3):
+                res = await client.post("/resize?width=100", data=jpg())
+                assert res.status == 200
+            await client.get("/bogus")  # a 404 for the RED counters
+            res = await client.get("/metrics")
+            assert res.status == 200
+            text = await res.text()
+            types, samples = parse_exposition_strict(text)
+            check_histograms(types, samples)
+            names = {n for n, _, _ in samples}
+            assert "imaginary_tpu_request_duration_seconds_bucket" in names
+            assert "imaginary_tpu_stage_duration_seconds_bucket" in names
+            assert "imaginary_tpu_requests_total" in names
+            # RED counters: route x status class, bounded labels
+            red = [(labels, v) for n, labels, v in samples
+                   if n == "imaginary_tpu_requests_total"]
+            assert any(labels.get("code") == "2xx" for labels, _ in red)
+            assert any(labels.get("code") == "4xx"
+                       and labels.get("route") == "unmatched"
+                       for labels, _ in red)
+            # stage histogram covers the pipeline stages
+            stages = {labels["stage"] for n, labels, _ in samples
+                      if n == "imaginary_tpu_stage_duration_seconds_bucket"}
+            assert {"decode", "encode", "total"} <= stages
+            # cache/executor counters are TYPEd as counters, gauges as gauges
+            assert types["imaginary_tpu_executor_items"] == "counter"
+            assert types["imaginary_tpu_executor_queue_depth"] == "gauge"
+
+        run(ServerOptions(), fn)
+
+    def test_label_values_escaped(self):
+        from imaginary_tpu.web.metrics import render_metrics
+
+        text = render_metrics({
+            "backend": 'we"ird\\backend',
+            "stageTimesMs": {
+                'de"code': {"count": 3, "mean_ms": 1.0, "p50_ms": 1.0,
+                            "p99_ms": 2.0},
+            },
+        })
+        types, samples = parse_exposition_strict(text)
+        backend = next(labels for n, labels, _ in samples
+                       if n == "imaginary_tpu_backend_info")
+        assert backend["backend"] == 'we\\"ird\\\\backend'
+
+
+# --- /debugz ------------------------------------------------------------------
+
+class TestDebugz:
+    def test_gated_off_by_default(self):
+        async def fn(client, _origin, _app):
+            res = await client.get("/debugz")
+            assert res.status == 404
+            res = await client.get("/debugz/profile?seconds=1")
+            assert res.status == 404
+
+        run(ServerOptions(), fn)
+
+    def test_enabled_payload_shape(self):
+        async def fn(client, _origin, _app):
+            await client.post("/resize?width=100", data=jpg())
+            res = await client.get("/debugz")
+            assert res.status == 200
+            body = await res.json()
+            for key in ("pid", "threads", "tasks", "slowest_requests",
+                        "executor", "executor_counters", "host_pool",
+                        "cache"):
+                assert key in body, key
+            assert isinstance(body["tasks"], list)
+            ex = body["executor"]
+            for key in ("queue_depth", "inflight_groups", "breaker_open",
+                        "owed_ms", "host_gate_free_permits"):
+                assert key in ex, key
+            assert body["host_pool"]["workers"] >= 1
+            # slow-request exemplars carry the full span timeline
+            slow = body["slowest_requests"]
+            assert slow and "spans" in slow[0] and "request_id" in slow[0]
+
+        obs_debugz.SLOW.clear()
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_api_key_guards_debugz_when_set(self):
+        async def fn(client, _origin, _app):
+            res = await client.get("/debugz")
+            assert res.status == 401
+            res = await client.get("/debugz", headers={"API-Key": "sekrit"})
+            assert res.status == 200
+
+        run(ServerOptions(enable_debug=True, api_key="sekrit"), fn)
+
+    def test_profile_requires_destination(self, monkeypatch):
+        monkeypatch.delenv("IMAGINARY_TPU_PROFILE_DIR", raising=False)
+
+        async def fn(client, _origin, _app):
+            res = await client.get("/debugz/profile?seconds=0.1")
+            assert res.status == 400
+            body = await res.json()
+            assert "IMAGINARY_TPU_PROFILE_DIR" in body["error"]
+
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_profile_dir_query_param_overrides_env(self, monkeypatch,
+                                                   tmp_path):
+        # the no-restart path: a process booted WITHOUT the env var can
+        # still name a destination per capture
+        monkeypatch.delenv("IMAGINARY_TPU_PROFILE_DIR", raising=False)
+
+        async def fn(client, _origin, _app):
+            res = await client.get(
+                "/debugz/profile", params={"seconds": "0.05",
+                                           "dir": str(tmp_path)})
+            assert res.status == 200
+            body = await res.json()
+            assert body["profile_dir"] == str(tmp_path)
+            import os
+
+            assert any(os.scandir(str(tmp_path)))
+
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_profile_one_shot_capture(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("IMAGINARY_TPU_PROFILE_DIR", str(tmp_path))
+
+        async def fn(client, _origin, _app):
+            res = await client.get("/debugz/profile?seconds=0.05")
+            assert res.status == 200
+            body = await res.json()
+            assert body["profile_dir"] == str(tmp_path)
+            # jax wrote a trace under the dir and the session is closed
+            # (a second capture can start)
+            import os
+
+            assert any(os.scandir(str(tmp_path)))
+            from imaginary_tpu.engine import timing
+
+            assert not timing.profiler_active()
+
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_profile_bad_seconds_rejected(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("IMAGINARY_TPU_PROFILE_DIR", str(tmp_path))
+
+        async def fn(client, _origin, _app):
+            res = await client.get("/debugz/profile?seconds=nope")
+            assert res.status == 400
+
+        run(ServerOptions(enable_debug=True), fn)
+
+
+# --- slow-request ring --------------------------------------------------------
+
+class TestSlowRing:
+    def test_slowest_ordering_and_bound(self):
+        ring = obs_debugz.SlowRing(keep=4)
+        for i, dur in enumerate([5.0, 50.0, 1.0, 20.0, 9.0]):
+            ring.note({"request_id": str(i), "duration_ms": dur})
+        top = ring.slowest(2)
+        # the oldest entry (5.0) aged out of the keep=4 window
+        assert [e["duration_ms"] for e in top] == [50.0, 20.0]
+        assert len(ring.slowest(100)) == 4

@@ -1027,10 +1027,12 @@ class TestAccessLogContract:
 
         line = self._capture("info", fn)
         assert "GET" in line and "HTTP/1.1" in line and " 200 " in line
-        # Apache-ish shape with 4-decimal latency (log.go:12,31)
+        # Apache-ish shape with 4-decimal latency (log.go:12,31), a
+        # timezone-offset timestamp, and the trailing request id
         import re
 
-        assert re.search(r'" 200 \d+ \d+\.\d{4}\n', line)
+        assert re.search(r'" 200 \d+ \d+\.\d{4} [0-9a-f]{32}\n', line)
+        assert re.search(r'\[\d{2}/\w{3}/\d{4}:\d{2}:\d{2}:\d{2} [+-]\d{4}\]', line)
 
     def test_error_level_silent_on_200(self):
         async def fn(client):
