@@ -1,15 +1,15 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs serve clean gate lint
+.PHONY: all native test bench bench-cache bench-obs bench-deadline chaos serve clean gate lint
 
 all: native test
 
 # No-red-snapshot gate (VERDICT r2 next #1): run before ANY commit meant
 # to be a round snapshot. Green means: lint is clean, full suite passes,
-# the driver's entry + 8-device dryrun execute, and bench.py emits its
-# JSON line (CPU fallback allowed — the gate checks the machinery, not
-# the chip).
-gate: lint test
+# the driver's entry + 8-device dryrun execute, bench.py emits its JSON
+# line, and the chaos drill holds its invariants (CPU fallback allowed —
+# the gate checks the machinery, not the chip).
+gate: lint test chaos
 	python __graft_entry__.py
 	BENCH_DURATION=2 BENCH_THREADS=8 python bench.py || \
 	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
@@ -17,7 +17,19 @@ gate: lint test
 	  { echo "bench_cache.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_obs.py || \
 	  { echo "bench_obs.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + bench + cache-bench + obs-bench all pass"
+	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_deadline.py || \
+	  { echo "bench_deadline.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline benches all pass"
+
+# Chaos drill (ISSUE 4): the deadline + failpoint suites, then a short
+# firehose soak with a flaky origin injected (source.fetch=error(0.2))
+# asserting availability >= 95%, honest 502/503/504 mapping, deadline
+# boundedness, and ledgers at rest. The failure modes the breaker/gate/
+# retry machinery exists for, exercised on every gate run.
+chaos:
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py -q
+	BENCH_DURATION=4 BENCH_CONCURRENCY=8 python bench_chaos.py || \
+	  { echo "chaos soak failed - resilience invariants violated"; exit 1; }
 
 # correctness-class lint (ruff.toml). FAILS the gate when ruff finds an
 # issue; hosts without ruff installed skip with a notice (the bench
@@ -52,6 +64,11 @@ bench-cache:
 # nonzero on gross overhead or missing tracing response surfaces
 bench-obs:
 	python bench_obs.py
+
+# headline throughput with request deadlines on (generous budget) vs off;
+# exits nonzero on gross overhead or any spurious shed/expiry
+bench-deadline:
+	python bench_deadline.py
 
 docker:
 	docker build -t imaginary-tpu .

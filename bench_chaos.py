@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Chaos soak: concurrent serving traffic with a flaky origin injected
+through the failpoint harness (`make chaos`).
+
+Arms IMAGINARY_TPU_FAILPOINTS="source.fetch=error(0.2)" through the same
+env path a production chaos drill would use (create_app reads it), then
+drives the cache-off zipf hot-URL row with deadlines ON. Invariants the
+soak enforces — the "only resilience you have is the resilience you
+exercise" check, run continuously, not once:
+
+  * availability: with a 0.2 per-attempt fault rate and the default
+    2-retry budget, per-request failure odds are 0.2^3 = 0.8% — the soak
+    demands >= 95% 2xx.
+  * honesty: every non-2xx is a well-formed 502/503/504, never a 500,
+    a hang, or a truncated body.
+  * boundedness: no request outlives the 10 s deadline + one tick.
+  * rest state: the coalescer group map and the host-pool inflight
+    ledger drain to zero after traffic stops.
+
+Prints one JSON line on stdout; human detail on stderr; nonzero exit on
+any violated invariant.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import sys
+import time
+
+import aiohttp
+
+
+async def _soak(duration: float, concurrency: int) -> dict:
+    from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, request_timeout_s=10.0))
+    service = app["service"]
+    counts: dict = {}
+    worst_ms = [0.0]
+    bad_bodies = [0]
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        deadline = time.monotonic() + duration
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def worker():
+                while time.monotonic() < deadline:
+                    t0 = time.monotonic()
+                    try:
+                        async with session.get(next(urls)) as res:
+                            body = await res.read()
+                            counts[res.status] = counts.get(res.status, 0) + 1
+                            if res.status == 200 and not body:
+                                bad_bodies[0] += 1
+                    except Exception:
+                        counts["exc"] = counts.get("exc", 0) + 1
+                    worst_ms[0] = max(
+                        worst_ms[0], (time.monotonic() - t0) * 1000.0)
+
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+        # rest-state invariants after traffic stops
+        for _ in range(100):
+            with service._inflight_lock:
+                inflight = service._inflight
+            if inflight == 0 and service.caches.flight.inflight() == 0:
+                break
+            await asyncio.sleep(0.02)
+        with service._inflight_lock:
+            inflight = service._inflight
+        groups = service.caches.flight.inflight()
+    finally:
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"counts": counts, "worst_ms": worst_ms[0],
+            "bad_bodies": bad_bodies[0], "inflight_after": inflight,
+            "groups_after": groups}
+
+
+def main() -> int:
+    from imaginary_tpu import failpoints
+    from bench_util import ensure_native_built
+
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "6"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8"))
+    os.environ[failpoints.ENV_VAR] = os.environ.get(
+        "CHAOS_FAILPOINTS", "source.fetch=error(0.2)")
+
+    print(f"[chaos] soak with {os.environ[failpoints.ENV_VAR]!r}: "
+          f"{concurrency} clients x {duration}s", file=sys.stderr)
+    got = asyncio.run(_soak(duration, concurrency))
+    failpoints.deactivate()
+    counts = got["counts"]
+    total = sum(counts.values())
+    ok = counts.get(200, 0)
+    allowed_errors = sum(counts.get(s, 0) for s in (502, 503, 504))
+    surprises = total - ok - allowed_errors
+    row = {
+        "metric": "chaos_soak",
+        "failpoints": os.environ[failpoints.ENV_VAR],
+        "requests": total,
+        "ok": ok,
+        "ok_ratio": round(ok / total, 4) if total else 0.0,
+        "mapped_errors": allowed_errors,
+        "surprises": surprises,
+        "worst_ms": round(got["worst_ms"], 1),
+        "inflight_after": got["inflight_after"],
+        "coalesce_groups_after": got["groups_after"],
+        "counts": {str(k): v for k, v in sorted(counts.items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if total == 0:
+        fails.append("soak produced zero requests")
+    if total and ok / total < 0.95:
+        fails.append(f"availability {ok}/{total} below 95% under 0.2 fault rate")
+    if surprises:
+        fails.append(f"{surprises} responses outside 200/502/503/504")
+    if got["bad_bodies"]:
+        fails.append(f"{got['bad_bodies']} empty 200 bodies")
+    if got["worst_ms"] > 12_000.0:
+        fails.append(f"worst request {got['worst_ms']:.0f}ms outlived the 10s deadline")
+    if got["inflight_after"] != 0:
+        fails.append(f"_inflight ledger leaked {got['inflight_after']}")
+    if got["groups_after"] != 0:
+        fails.append(f"coalescer leaked {got['groups_after']} groups")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[chaos] PASS: {ok}/{total} ok, {allowed_errors} mapped errors, "
+          f"worst {got['worst_ms']:.0f}ms, ledgers at rest", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Deadline bookkeeping overhead benchmark: the row ISSUE-4's tentpole is
+graded on.
+
+Same harness as bench_obs.py (cache-off zipf hot-URL row — every request
+pays fetch -> decode -> process -> encode, so per-request deadline cost
+cannot hide behind cache hits), ABBA-interleaved to cancel host drift.
+Two arms:
+
+  * deadlines OFF (--request-timeout unset: the parity default — zero
+    Deadline objects minted, every call site takes its None fast path)
+  * deadlines ON  (--request-timeout 60: every request mints a Deadline
+    and pays the note/check bookkeeping at admission, fetch, queue,
+    device wait, pool entry, and encode — but never expires)
+
+Prints one JSON line on stdout; human detail on stderr. Exits nonzero
+when the ON arm lost more than BENCH_DEADLINE_MAX_OVERHEAD_PCT (default
+10 — a gross-regression gate tolerant of short-run noise; the acceptance
+criterion is "no measurable overhead" on a full-length run) or when the
+ON arm produced any spurious 503/504 under its generous budget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+from bench_obs import _arm
+from bench_util import ensure_native_built, make_1080p_jpeg, pctl
+
+
+def main() -> int:
+    from bench_cache import N_URLS as CACHE_N_URLS
+    from imaginary_tpu.web.config import ServerOptions
+
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "16"))
+    max_overhead = float(os.environ.get("BENCH_DEADLINE_MAX_OVERHEAD_PCT", "10"))
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(CACHE_N_URLS)]
+
+    print(f"[deadline-bench] cache-off zipf row, deadlines on vs off: "
+          f"{concurrency} clients x {duration}s per arm, ABBA-interleaved",
+          file=sys.stderr)
+    slice_s = max(duration / 2.0, 1.0)
+    totals = {True: [0.0, [], 0], False: [0.0, [], 0]}
+    for arm_on in (False, True, True, False):
+        rps, lats, errs = asyncio.run(_arm(
+            ServerOptions(enable_url_source=True,
+                          request_timeout_s=60.0 if arm_on else 0.0),
+            variants, slice_s, concurrency, check_headers=False))
+        totals[arm_on][0] += rps
+        totals[arm_on][1].extend(lats)
+        totals[arm_on][2] += errs
+    rps_off, lats_off, err_off = totals[False][0] / 2, totals[False][1], totals[False][2]
+    rps_on, lats_on, err_on = totals[True][0] / 2, totals[True][1], totals[True][2]
+
+    overhead_pct = (100.0 * (rps_off - rps_on) / rps_off) if rps_off else 0.0
+    row = {
+        "metric": "deadline_bookkeeping_overhead",
+        "unit": "req/s",
+        "value": round(rps_on, 2),
+        "value_deadline_off": round(rps_off, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "p50_ms": pctl(lats_on, 0.50),
+        "p99_ms": pctl(lats_on, 0.99),
+        "p50_ms_deadline_off": pctl(lats_off, 0.50),
+        "p99_ms_deadline_off": pctl(lats_off, 0.99),
+        "errors_on": err_on,
+        "errors_off": err_off,
+    }
+    print(json.dumps(row))
+
+    if err_on > err_off:
+        # a generous 60 s budget must never shed or expire a request: any
+        # extra error in the ON arm is a correctness bug, not noise
+        print(f"[deadline-bench] FAIL: deadline arm added errors "
+              f"({err_off} -> {err_on})", file=sys.stderr)
+        return 1
+    if overhead_pct > max_overhead:
+        print(f"[deadline-bench] FAIL: deadline overhead {overhead_pct:.1f}% "
+              f"exceeds {max_overhead:.1f}% gate", file=sys.stderr)
+        return 1
+    print(f"[deadline-bench] deadline overhead {overhead_pct:.1f}% "
+          f"({rps_off:.1f} -> {rps_on:.1f} req/s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from imaginary_tpu import failpoints
 from imaginary_tpu.obs import trace as obs_trace
 
 
@@ -92,6 +93,10 @@ class ByteBudgetLRU:
             return self._bytes
 
     def get(self, key) -> Optional[Any]:
+        # chaos site for every tier's lookup; consumers (result lookup,
+        # FrameCache, the source cache) degrade an injected error to a
+        # miss — a broken cache must cost latency, not availability
+        failpoints.hit("cache.get")
         with self._lock:
             entry = self._map.get(key)
             if entry is None:
@@ -302,7 +307,10 @@ class FrameCache:
         return self._lru.enabled
 
     def get(self, key):
-        got = self._lru.get(key)
+        try:
+            got = self._lru.get(key)
+        except Exception:
+            got = None  # failing tier reads as a miss (see ByteBudgetLRU.get)
         if got is None:
             self._stats.frame_misses += 1
         else:

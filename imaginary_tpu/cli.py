@@ -133,6 +133,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-queue-ms", type=float, default=0.0,
                    help="shed load (503) when estimated queueing delay "
                         "exceeds this; 0 disables")
+    # request lifecycle robustness (imaginary_tpu/deadline.py +
+    # web/sources.py retry policy); --request-timeout defaults OFF so the
+    # serving path stays byte-identical to the reference build
+    p.add_argument("--request-timeout", type=float,
+                   default=_env_float("IMAGINARY_TPU_REQUEST_TIMEOUT", 0.0),
+                   help="end-to-end per-request deadline in seconds, "
+                        "enforced at every hop (admission, fetch, queue, "
+                        "execute, encode); also the clamp ceiling for the "
+                        "X-Request-Timeout header; 0 disables")
+    p.add_argument("--source-retries", type=int, default=2,
+                   help="retry budget for remote ?url=/watermark fetches "
+                        "(connect errors, timeouts, 5xx, 429; exponential "
+                        "backoff + full jitter, honors Retry-After)")
+    p.add_argument("--source-connect-timeout", type=float, default=5.0,
+                   help="per-attempt origin connect timeout in seconds")
+    p.add_argument("--source-read-timeout", type=float, default=30.0,
+                   help="per-attempt origin total read timeout in seconds")
     p.add_argument("--workers", type=int, default=1,
                    help="serving processes on one port via SO_REUSEPORT "
                         "(0 = one per CPU core); worker 0 owns the device, "
@@ -270,6 +287,10 @@ def options_from_args(args) -> ServerOptions:
         endpoints=parse_endpoints(args.disable_endpoints),
         workers=_resolve_workers(args.workers),
         max_queue_ms=max(0.0, args.max_queue_ms),
+        request_timeout_s=max(0.0, args.request_timeout),
+        source_retries=max(0, args.source_retries),
+        source_connect_timeout_s=max(0.001, args.source_connect_timeout),
+        source_read_timeout_s=max(0.001, args.source_read_timeout),
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,

@@ -1,0 +1,115 @@
+"""End-to-end per-request deadlines ("The Tail at Scale" deadline
+propagation, Dean & Barroso CACM 2013).
+
+One Deadline is minted per request by the trace middleware (web/
+middleware.py) when `--request-timeout` is set, and rides the SAME
+contextvar vehicle as the request trace (obs/trace.py RequestTrace) —
+`contextvars.copy_context()` already carries that into the host worker
+pool, so every hop the request touches can read its remaining budget
+without a new plumbing channel:
+
+  admission      shed 503 when the estimated queue delay exceeds the budget
+  source fetch   per-attempt origin timeouts derived from remaining budget
+  coalesce wait  a follower stops waiting when ITS budget expires (the
+                 leader's shared run is never cancelled)
+  executor queue a future whose deadline passed while queued is cancelled
+                 and its _inflight ledger entry released — never a worker
+  host pool      a worker that dequeues an already-expired request bails
+                 before decoding a single byte
+  encode         the last stage boundary checks before paying the encoder
+
+Expiry after admission is a 504 carrying the elapsed/budget breakdown
+(errors.DeadlineExceeded); the stage checkpoints land in the wide event /
+slow-ring surfaces via the middleware's final annotate.
+
+Everything here is a no-op when `--request-timeout` is off (the default):
+`current()` returns None and call sites skip — the parity path stays
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from imaginary_tpu.errors import DeadlineExceeded
+from imaginary_tpu.obs import trace as obs_trace
+
+_MAX_CHECKPOINTS = 32  # a retry loop must not grow a deadline unbounded
+
+
+class Deadline:
+    """Monotonic budget for one request. Thread-compatible the same way
+    RequestTrace is: the handler path touches it sequentially (the async
+    task OR the one pool thread that owns the request at that moment)."""
+
+    __slots__ = ("t0", "budget_s", "checkpoints")
+
+    def __init__(self, budget_s: float, t0: Optional[float] = None):
+        self.t0 = time.monotonic() if t0 is None else t0
+        self.budget_s = float(budget_s)
+        self.checkpoints: list = []  # (stage, remaining_ms) in arrival order
+
+    def elapsed_s(self) -> float:
+        return time.monotonic() - self.t0
+
+    def remaining_s(self) -> float:
+        return self.budget_s - self.elapsed_s()
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def note(self, stage: str) -> float:
+        """Record remaining budget at a stage boundary; returns remaining
+        seconds (possibly negative)."""
+        rem = self.remaining_s()
+        if len(self.checkpoints) < _MAX_CHECKPOINTS:
+            self.checkpoints.append((stage, round(rem * 1000.0, 1)))
+        return rem
+
+    def check(self, stage: str) -> None:
+        """Raise the 504 if the budget is spent; otherwise just checkpoint."""
+        if self.note(stage) <= 0.0:
+            raise self.error(stage)
+
+    def error(self, stage: str) -> DeadlineExceeded:
+        return DeadlineExceeded(stage, self.elapsed_s() * 1000.0,
+                                self.budget_s * 1000.0)
+
+    def stages_dict(self) -> dict:
+        """Remaining-at-stage map for the wide-event surface (last write
+        wins when a stage checkpoints more than once, e.g. fetch retries)."""
+        return dict(self.checkpoints)
+
+
+def resolve_budget(server_max_s: float, header_value: str) -> float:
+    """The minting rule: `--request-timeout` is both the default budget and
+    the clamp ceiling for the per-request `X-Request-Timeout` header
+    (seconds, float). 0 = deadlines off entirely — a header cannot enable
+    what the operator left off. Invalid or non-positive header values fall
+    back to the server default."""
+    if server_max_s <= 0.0:
+        return 0.0
+    if header_value:
+        try:
+            v = float(header_value)
+        except ValueError:
+            v = 0.0
+        if v > 0.0:
+            return min(v, server_max_s)
+    return server_max_s
+
+
+def current() -> Optional[Deadline]:
+    """The current request's deadline, or None (no trace active, or
+    deadlines off). Rides RequestTrace so copy_context() carries exactly
+    one vehicle into pool threads."""
+    tr = obs_trace.current()
+    return tr.deadline if tr is not None else None
+
+
+def check(stage: str) -> None:
+    """Module-level convenience: no-op without an active deadline."""
+    dl = current()
+    if dl is not None:
+        dl.check(stage)

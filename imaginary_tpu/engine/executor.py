@@ -23,6 +23,7 @@ from typing import Optional
 
 import numpy as np
 
+from imaginary_tpu import failpoints
 from imaginary_tpu.engine import host_exec
 from imaginary_tpu.engine.timing import TIMES
 from imaginary_tpu.ops import chain as chain_mod
@@ -473,6 +474,7 @@ class Executor:
         plan is host-executable, it runs inline on the caller's thread
         instead of queueing behind a drain the link can't keep up with.
         """
+        failpoints.hit("executor.submit")
         item = _Item(arr, plan)
         _PLACEMENT.value = "device"
         if not plan.stages:  # identity chain: no device work at all
@@ -505,6 +507,10 @@ class Executor:
             TIMES.record("host_gate", (t0 - tg) * 1000.0)
             c0 = time.thread_time()
             try:
+                # failpoint INSIDE the guarded region: an injected spill
+                # fault must take the same fall-through-to-device path a
+                # real host-interpreter edge case would
+                failpoints.hit("host.spill")
                 out = host_exec.run(arr, plan)
             except Exception:
                 # A host-interpreter edge case must not become a user-visible
@@ -829,6 +835,10 @@ class Executor:
             TIMES.record("queue_wait", (now - it.t) * 1000.0)
         cache_before = chain_mod.cache_size()
         try:
+            # chaos site: delay() models a slow device/link (the collector
+            # IS the dispatch path), error() a failed dispatch — which
+            # books a device failure and, consecutively, opens the breaker
+            failpoints.hit("device.execute")
             for start in range(0, len(items), self.config.max_batch):
                 sub = items[start : start + self.config.max_batch]
                 y, arrs, plans = self._launch_chunk(sub)
@@ -836,7 +846,11 @@ class Executor:
         except Exception as e:
             self._note_device_failure()
             for it in items:
-                it.future.set_exception(e)
+                # done() covers deadline-cancelled futures: set_exception
+                # on a cancelled future raises InvalidStateError and would
+                # kill the collector thread
+                if not it.future.done():
+                    it.future.set_exception(e)
             return
         # A cache-size bump means this group's launch paid an XLA compile;
         # its drain time must not seed the cost model (a multi-second compile

@@ -12,12 +12,19 @@ import json
 
 
 class ImageError(Exception):
-    """ref: error.go:30-56 (message newlines stripped, code clamped)."""
+    """ref: error.go:30-56 (message newlines stripped, code clamped).
 
-    def __init__(self, message: str, code: int):
+    `headers` ride onto the HTTP error response (e.g. Retry-After on a
+    shed 503 so well-behaved clients back off); `extra` keys merge into
+    the JSON body (e.g. the deadline elapsed/budget breakdown)."""
+
+    def __init__(self, message: str, code: int, headers: dict = None,
+                 extra: dict = None):
         super().__init__(message)
         self.message = message.replace("\n", "")
         self.code = code
+        self.headers = dict(headers) if headers else {}
+        self.extra = dict(extra) if extra else {}
 
     def http_code(self) -> int:
         if 400 <= self.code <= 511:
@@ -28,14 +35,36 @@ class ImageError(Exception):
         body: dict = {"status": self.code}
         if self.message:
             body = {"message": self.message, "status": self.code}
+        if self.extra:
+            body.update(self.extra)
         return json.dumps(body).encode()
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"ImageError({self.message!r}, {self.code})"
 
 
-def new_error(message: str, code: int) -> ImageError:
-    return ImageError(message, code)
+def new_error(message: str, code: int, headers: dict = None,
+              extra: dict = None) -> ImageError:
+    return ImageError(message, code, headers=headers, extra=extra)
+
+
+class DeadlineExceeded(ImageError):
+    """Per-request deadline expiry after admission: 504 with the
+    elapsed/budget breakdown in the error body (imaginary_tpu/deadline.py
+    mints these at every enforced hop)."""
+
+    def __init__(self, stage: str, elapsed_ms: float, budget_ms: float):
+        super().__init__(
+            f"request deadline exceeded at {stage}: elapsed "
+            f"{elapsed_ms:.0f}ms of {budget_ms:.0f}ms budget",
+            504,
+            extra={
+                "stage": stage,
+                "elapsed_ms": round(elapsed_ms, 1),
+                "budget_ms": round(budget_ms, 1),
+            },
+        )
+        self.stage = stage
 
 
 # Predefined errors (ref: error.go:12-28)

@@ -1,0 +1,250 @@
+"""Failpoint fault-injection harness (the chaos half of the robustness layer).
+
+"Fail at Scale" (Maurer, ACM Queue 2015) argues the only resilience a
+service actually has is the resilience it routinely *exercises*: the
+breaker, the retry policy, the drain watchdog, and the admission gate in
+this codebase all exist to handle failures that a healthy dev box never
+produces. This module gives every one of those mechanisms a provoking
+lever: named injection sites compiled down to a near-free no-op when
+disabled, and a tiny spec grammar to arm them.
+
+Sites (each named for the subsystem boundary it sits on):
+
+  source.fetch     one remote ?url=/watermark GET attempt (web/sources.py)
+  source.head      the HEAD size pre-check (web/sources.py)
+  codec.decode     host image decode (pipeline.py, pool thread)
+  executor.submit  micro-batch executor entry (engine/executor.py)
+  device.execute   device dispatch inside the collector (engine/executor.py)
+  host.spill       the host SIMD spill branch (engine/executor.py)
+  codec.encode     host image encode (pipeline.py, pool thread)
+  cache.get        any cache-tier lookup (cache.py ByteBudgetLRU)
+
+Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
+
+  SPEC    := SITE=ACTION [";" SITE=ACTION]*
+  ACTION  := error["(" P ")"]          raise FailpointError, probability P (default 1)
+           | delay "(" DURATION ")"    sleep, then continue normally
+           | timeout["(" DURATION ")"] sleep DURATION (default 60s), then raise
+                                       TimeoutError (async sites raise
+                                       asyncio.TimeoutError so the caller's
+                                       timeout classification fires)
+           | once "(" ACTION ")"       fire the wrapped action exactly once
+  DURATION := FLOAT ("ms" | "s")       e.g. 200ms, 1.5s
+
+Example: IMAGINARY_TPU_FAILPOINTS="source.fetch=error(0.5);device.execute=delay(200ms)"
+
+Hot-path cost when disabled: `hit()` is one falsy-dict check — the
+activation swap replaces the whole dict, so an idle process never takes
+the lock or touches per-site state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import re
+import threading
+import time
+from typing import Optional
+
+SITES = (
+    "source.fetch",
+    "source.head",
+    "codec.decode",
+    "executor.submit",
+    "device.execute",
+    "host.spill",
+    "codec.encode",
+    "cache.get",
+)
+
+ENV_VAR = "IMAGINARY_TPU_FAILPOINTS"
+
+_DEFAULT_TIMEOUT_S = 60.0
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)$")
+
+
+class FailpointError(RuntimeError):
+    """An injected fault. Deliberately NOT an ImageError: it surfaces
+    through the same generic exception paths a real subsystem failure
+    would, so the chaos suite exercises the honest error mapping."""
+
+
+class _Spec:
+    __slots__ = ("kind", "p", "duration_s", "once", "raw")
+
+    def __init__(self, kind: str, p: float = 1.0, duration_s: float = 0.0,
+                 once: bool = False, raw: str = ""):
+        self.kind = kind  # error | delay | timeout
+        self.p = p
+        self.duration_s = duration_s
+        self.once = once
+        self.raw = raw
+
+
+def _parse_duration(text: str) -> float:
+    m = _DURATION_RE.match(text.strip())
+    if not m:
+        raise ValueError(f"bad duration {text!r} (want e.g. 200ms or 1.5s)")
+    v = float(m.group(1))
+    return v / 1000.0 if m.group(2) == "ms" else v
+
+
+def _parse_action(text: str) -> _Spec:
+    text = text.strip()
+    m = re.match(r"^(\w+)(?:\((.*)\))?$", text)
+    if not m:
+        raise ValueError(f"bad action {text!r}")
+    name, arg = m.group(1), m.group(2)
+    if name == "once":
+        if not arg:
+            raise ValueError("once needs a wrapped action, e.g. once(error)")
+        inner = _parse_action(arg)
+        inner.once = True
+        inner.raw = text
+        return inner
+    if name == "error":
+        p = 1.0
+        if arg:
+            p = float(arg)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"error probability {p} outside [0, 1]")
+        return _Spec("error", p=p, raw=text)
+    if name == "delay":
+        if not arg:
+            raise ValueError("delay needs a duration, e.g. delay(200ms)")
+        return _Spec("delay", duration_s=_parse_duration(arg), raw=text)
+    if name == "timeout":
+        dur = _parse_duration(arg) if arg else _DEFAULT_TIMEOUT_S
+        return _Spec("timeout", duration_s=dur, raw=text)
+    raise ValueError(f"unknown failpoint action {name!r}")
+
+
+def parse(spec: str) -> dict:
+    """Parse a spec string into {site: _Spec}; raises ValueError on any
+    unknown site or malformed action (an operator typo must fail loudly,
+    not silently arm nothing)."""
+    out: dict = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad failpoint clause {part!r} (want site=action)")
+        site, action = part.split("=", 1)
+        site = site.strip()
+        if site not in SITES:
+            raise ValueError(
+                f"unknown failpoint site {site!r} (known: {', '.join(SITES)})")
+        out[site] = _parse_action(action)
+    return out
+
+
+# The active map is swapped WHOLE on (de)activation: hit() reads it with a
+# plain attribute load, so the disabled fast path is one falsy check with
+# no lock. _counts survives deactivation until the next activate so the
+# /debugz surface can report what a finished chaos run actually fired.
+_active: dict = {}
+_counts: dict = {}  # site -> [hits, fired]
+_lock = threading.Lock()
+
+
+def activate(spec: str) -> None:
+    """Arm the failpoints described by `spec`; empty string disarms."""
+    global _active, _counts
+    parsed = parse(spec)
+    with _lock:
+        _active = parsed
+        _counts = {site: [0, 0] for site in parsed}
+
+
+def deactivate() -> None:
+    global _active
+    with _lock:
+        _active = {}
+
+
+def activate_from_env(environ=None) -> bool:
+    """Arm from IMAGINARY_TPU_FAILPOINTS if set; returns whether anything
+    was armed. Called at app assembly, not import, so test processes stay
+    hermetic."""
+    import os
+
+    spec = (environ or os.environ).get(ENV_VAR, "").strip()
+    if not spec:
+        return False
+    activate(spec)
+    return True
+
+
+def active_spec() -> str:
+    """Render the live configuration back into the spec grammar."""
+    return ";".join(f"{site}={sp.raw}" for site, sp in _active.items())
+
+
+def snapshot() -> dict:
+    """The /debugz/failpoints GET body."""
+    with _lock:
+        sites = {
+            site: {
+                "action": sp.raw,
+                "hits": _counts.get(site, [0, 0])[0],
+                "fired": _counts.get(site, [0, 0])[1],
+            }
+            for site, sp in _active.items()
+        }
+        # sites that were armed and already spent (once) keep their counts
+        for site, c in _counts.items():
+            sites.setdefault(site, {"action": "(spent)", "hits": c[0],
+                                    "fired": c[1]})
+    return {"enabled": bool(_active), "spec": active_spec(), "sites": sites}
+
+
+def _decide(site: str) -> Optional[_Spec]:
+    active = _active
+    if not active:
+        return None
+    sp = active.get(site)
+    if sp is None:
+        return None
+    with _lock:
+        c = _counts.setdefault(site, [0, 0])
+        c[0] += 1
+        if sp.p < 1.0 and random.random() >= sp.p:
+            return None
+        c[1] += 1
+        if sp.once:
+            # spent: drop from the active map (snapshot keeps the counts)
+            active.pop(site, None)
+    return sp
+
+
+def hit(site: str) -> None:
+    """Synchronous injection site (pool/collector threads). No-op unless
+    armed for `site`."""
+    sp = _decide(site)
+    if sp is None:
+        return
+    if sp.kind == "delay":
+        time.sleep(sp.duration_s)
+        return
+    if sp.kind == "timeout":
+        time.sleep(sp.duration_s)
+        raise TimeoutError(f"failpoint {site}: injected timeout")
+    raise FailpointError(f"failpoint {site}: injected error")
+
+
+async def ahit(site: str) -> None:
+    """Async injection site (event-loop paths). `timeout` raises
+    asyncio.TimeoutError so callers' timeout classification (e.g. the
+    origin-fetch 504 mapping) fires exactly as on a real stall."""
+    sp = _decide(site)
+    if sp is None:
+        return
+    if sp.kind == "delay":
+        await asyncio.sleep(sp.duration_s)
+        return
+    if sp.kind == "timeout":
+        await asyncio.sleep(sp.duration_s)
+        raise asyncio.TimeoutError(f"failpoint {site}: injected timeout")
+    raise FailpointError(f"failpoint {site}: injected error")

@@ -74,11 +74,18 @@ def task_dump(limit: int = 200) -> list:
 def debug_payload(service) -> dict:
     """The /debugz JSON body: tasks, executor + host-pool occupancy,
     cache tier summary, slow-request exemplars."""
+    from imaginary_tpu import failpoints
+
     payload: dict = {
         "pid": os.getpid(),
         "threads": threading.active_count(),
         "tasks": task_dump(),
         "slowest_requests": SLOW.slowest(32),
+        # chaos harness state (spec + per-site hit/fired counters); the
+        # control surface is the sibling /debugz/failpoints GET/PUT.
+        # Deadline state per request rides the slow-ring events above
+        # (deadline_budget_ms / deadline_remaining_ms / deadline_stages).
+        "failpoints": failpoints.snapshot(),
     }
     if service is not None:
         payload["executor"] = service.executor.debug_snapshot()

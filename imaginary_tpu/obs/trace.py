@@ -73,7 +73,8 @@ class RequestTrace:
     """One request's identity + span timeline + wide-event fields."""
 
     __slots__ = ("request_id", "trace_id", "parent_span_id", "span_id",
-                 "flags", "enabled", "t0", "spans", "fields", "_lock")
+                 "flags", "enabled", "t0", "spans", "fields", "deadline",
+                 "_lock")
 
     def __init__(self, request_id: str, traceparent: str = "",
                  enabled: bool = True):
@@ -95,6 +96,12 @@ class RequestTrace:
         self.t0 = time.monotonic()
         self.spans: list = []
         self.fields: dict = {}
+        # Per-request deadline (imaginary_tpu/deadline.py), set by the web
+        # middleware when --request-timeout is on. It rides the trace so
+        # copy_context() carries exactly ONE vehicle into pool threads —
+        # deadline enforcement works even with tracing disabled (enabled
+        # gates span accumulation, not identity or lifecycle state).
+        self.deadline = None
         self._lock = threading.Lock()
 
     # -- accumulation (called from handler tasks AND pool threads) ---------

@@ -22,6 +22,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from imaginary_tpu import codecs
+from imaginary_tpu import deadline as deadline_mod
+from imaginary_tpu import failpoints
 from imaginary_tpu.engine.timing import TIMES
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.codecs import EncodeOptions, YuvPlanes
@@ -81,6 +83,11 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
     non-JPEG target (mid-pipeline type switch) or raw-encode failure
     converts the planes to RGB and takes the normal path.
     """
+    # last stage boundary before the response: a request whose budget
+    # expired during device execute must not pay for an encode nobody
+    # will receive (no-op without an active deadline)
+    deadline_mod.check("encode")
+    failpoints.hit("codec.encode")
     opts = EncodeOptions(
         type=target,
         quality=o.quality,
@@ -264,6 +271,7 @@ def _decode_cached(buf, shrink, frame_cache=None, digest=None):
         if d is not None:
             TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
             return d
+    failpoints.hit("codec.decode")
     d = codecs.decode(buf, shrink)
     if key is not None:
         d.array.setflags(write=False)
@@ -286,6 +294,7 @@ def _decode_yuv_packed(buf, shrink, sh, sw, frame_cache=None, digest=None):
         if hit is not None:
             return hit
     t0 = time.monotonic()
+    failpoints.hit("codec.decode")
     try:
         packed, h, w, _orient = codecs.decode_yuv420(buf, shrink, hb, wb)
     except ImageError:

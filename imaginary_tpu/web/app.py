@@ -43,6 +43,12 @@ def tune_gc_for_serving() -> None:
 
 
 def create_app(o: ServerOptions, log_stream=None) -> web.Application:
+    # arm failpoints from IMAGINARY_TPU_FAILPOINTS at assembly (not module
+    # import) so test processes stay hermetic; a bad spec must kill the
+    # boot loudly, not silently arm nothing
+    from imaginary_tpu import failpoints
+
+    failpoints.activate_from_env()
     # trace middleware is OUTERMOST: it assigns request identity and
     # installs the contextvar trace before the access log (which reads
     # the id) and everything inside it runs
@@ -77,6 +83,10 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     add(prefix + "/debugz", partial(_debugz, service, o), methods=("GET",))
     add(prefix + "/debugz/profile", partial(_debugz_profile, o),
         methods=("GET",))
+    # runtime chaos control: GET = live spec + hit/fired counters, PUT =
+    # arm a new spec (empty body disarms). Same gate as /debugz.
+    add(prefix + "/debugz/failpoints", partial(_debugz_failpoints, o),
+        methods=("GET", "PUT"))
 
     for name in ALL_OPERATIONS:
         route = "/" + (name.lower() if name == "watermarkImage" else name)
@@ -132,6 +142,23 @@ async def _debugz_profile(o, request):
 
     body, status = await profile_capture(request.query)
     return web.json_response(body, status=status)
+
+
+async def _debugz_failpoints(o, request):
+    if not o.enable_debug:
+        from imaginary_tpu.errors import ErrNotFound
+        from imaginary_tpu.web.middleware import error_response
+
+        return error_response(request, ErrNotFound, o)
+    from imaginary_tpu import failpoints
+
+    if request.method == "PUT":
+        spec = (await request.text()).strip()
+        try:
+            failpoints.activate(spec)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(failpoints.snapshot())
 
 
 def _pin_groups(ctx) -> bool:
@@ -266,6 +293,15 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
         print(f"imaginary-tpu server listening on {scheme}://{o.address or '0.0.0.0'}:{o.port}{proto}")
         await stop.wait()
         print("shutting down server")
+        # Shutdown drain: new non-public arrivals during the grace window
+        # get a fast 503 + Retry-After (trace middleware) instead of
+        # racing the teardown into a connection reset; the h2 terminator
+        # sheds new streams the same way (web/http2.py set_draining).
+        app["draining"] = True
+        if h2_server is not None:
+            from imaginary_tpu.web import http2 as http2_mod
+
+            http2_mod.set_draining(True)
         if ticker:
             ticker.cancel()
         if h2_server is not None:

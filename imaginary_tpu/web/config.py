@@ -56,6 +56,20 @@ class ServerOptions:
     # queueing delay (host backlog + device owed-work ledger) exceeds this
     # many ms; 0 disables (GCRA still bounds the RATE either way)
     max_queue_ms: float = 0.0
+    # --- request lifecycle robustness (imaginary_tpu/deadline.py) ------------
+    # End-to-end per-request deadline in seconds; ALSO the clamp ceiling
+    # for the per-request X-Request-Timeout header. 0 = off (parity: the
+    # serving path is byte-identical with deadlines disabled).
+    request_timeout_s: float = 0.0
+    # Resilient ?url=/watermark origin fetches (web/sources.py): bounded
+    # retries with exponential backoff + full jitter on connect errors,
+    # timeouts, 5xx and 429 (honoring Retry-After; other 4xx never retry).
+    source_retries: int = 2
+    # Per-ATTEMPT connect/read timeouts, split out of the 60 s total so a
+    # black-holed origin fails the attempt in seconds and the retry (or
+    # the request deadline) decides what happens next.
+    source_connect_timeout_s: float = 5.0
+    source_read_timeout_s: float = 30.0
     # --- TPU engine knobs (no reference counterpart) -------------------------
     batch_window_ms: float = 3.0
     # default mirrors engine.executor.MAX_BATCH (kept literal here so this

@@ -16,6 +16,7 @@ import hashlib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Optional
 
 import numpy as np
@@ -23,6 +24,7 @@ from aiohttp import web
 
 from imaginary_tpu import cache as cache_mod
 from imaginary_tpu import codecs
+from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu.engine import Executor, ExecutorConfig
 from imaginary_tpu.errors import (
     ErrEmptyBody,
@@ -54,6 +56,12 @@ from imaginary_tpu.web.middleware import (
 from imaginary_tpu.web.sources import SourceRegistry
 
 _ACCEPT_TO_TYPE = {"image/webp": "webp", "image/png": "png", "image/jpeg": "jpeg"}
+
+
+def _retry_after_s(est_ms: Optional[float]) -> str:
+    """Retry-After seconds for a shed 503, derived from the queue estimate
+    (floor 1 s — sub-second retry hints just synchronize the herd)."""
+    return str(max(1, int((est_ms or 0.0) / 1000.0 + 0.5)))
 
 
 def determine_accept_mime_type(accept: str) -> str:
@@ -125,16 +133,37 @@ class ImageService:
         tr = obs_trace.current()
         if tr is not None:
             tr.annotate(op=op_name)
+        dl = deadline_mod.current()
         try:
             if o.enable_url_signature:
                 check_url_signature(request, o)
             validate_image_request(request, o)
-            if o.max_queue_ms > 0 and self.estimated_queue_ms() > o.max_queue_ms:
+            est_ms = None
+            if o.max_queue_ms > 0 or dl is not None:
+                est_ms = self.estimated_queue_ms()
+            if o.max_queue_ms > 0 and est_ms > o.max_queue_ms:
                 # depth-based admission control: shed load BEFORE fetching
                 # the source — at overload an operator wants bounded
                 # latency + fast 503s, not an unbounded queue (GCRA bounds
-                # the rate; this bounds what a burst can pile up)
-                raise new_error("Server queue is full, retry later", 503)
+                # the rate; this bounds what a burst can pile up).
+                # Retry-After mirrors the rate-limiter's 503 contract so
+                # well-behaved clients back off instead of hammering.
+                raise new_error(
+                    "Server queue is full, retry later", 503,
+                    headers={"Retry-After": _retry_after_s(est_ms)})
+            if dl is not None:
+                # deadline admission ("The Tail at Scale" deadline
+                # propagation): when the estimated queue delay already
+                # exceeds the remaining budget, a 503 NOW is strictly
+                # better than a guaranteed 504 after the client's money
+                # was spent — the request is shed before any work
+                rem = dl.note("admission")
+                if rem <= 0.0:
+                    raise dl.error("admission")
+                if est_ms > rem * 1000.0:
+                    raise new_error(
+                        "Server queue exceeds request deadline, retry later",
+                        503, headers={"Retry-After": _retry_after_s(est_ms)})
             with obs_trace.span("fetch"):
                 buf = await self._get_source_image(request)
             if not buf:
@@ -231,7 +260,12 @@ class ImageService:
                     if vary:
                         headers["Vary"] = vary
                     return web.Response(status=304, headers=headers)
-                hit = caches.result.get(key)
+                try:
+                    hit = caches.result.get(key)
+                except Exception:
+                    # a failing cache tier degrades to a miss, never to a
+                    # failed request (failpoint cache.get proves it)
+                    hit = None
             if hit is not None:
                 caches.stats.result_hits += 1
                 if tr is not None:
@@ -273,16 +307,36 @@ class ImageService:
             fut.add_done_callback(self._release_if_cancelled)
             return await asyncio.wrap_future(fut)
 
-        try:
+        async def run_work():
             if caches.coalesce and key is not None:
                 # singleflight: N concurrent identical (digest, plan)
                 # requests run produce() ONCE — one _inflight unit, one
                 # pipeline run — and every waiter (shielded, so a client
                 # disconnect detaches without cancelling the group) gets
                 # the same result or the same error
-                out, placement = await caches.flight.run(key, produce)
+                return await caches.flight.run(key, produce)
+            return await produce()
+
+        dl = deadline_mod.current()
+        try:
+            if dl is None:
+                out, placement = await run_work()
             else:
-                out, placement = await produce()
+                # The deadline's one await-side enforcement point: bounds
+                # the coalesce wait, the executor/pool queue wait, and the
+                # work itself. wait_for's cancellation does the right thing
+                # on both paths: a pool future still QUEUED is cancelled
+                # and _release_if_cancelled balances the _inflight ledger
+                # (the worker never runs it); a coalesce FOLLOWER detaches
+                # from the shielded group task without cancelling the
+                # leader's run other waiters depend on.
+                rem = dl.note("queue")
+                if rem <= 0.0:
+                    raise dl.error("queue")
+                try:
+                    out, placement = await asyncio.wait_for(run_work(), rem)
+                except asyncio.TimeoutError:
+                    raise dl.error("queue") from None
         except ImageError:
             raise
         except Exception as e:
@@ -353,6 +407,11 @@ class ImageService:
         # inflated-EWMA grows quadratically with queue depth).
         t0 = time.monotonic()
         try:
+            # a request that expired while queued must not cost a single
+            # decoded byte: bail here so the worker frees immediately (the
+            # async side already 504'd via wait_for; this keeps the pool
+            # honest when the future started running right at the buzzer)
+            deadline_mod.check("host_pool")
             return self._process_sync_inner(op_name, buf, opts, wm_rgba,
                                             meta, digest)
         finally:
@@ -370,11 +429,30 @@ class ImageService:
         reset_placement()
         out = process_operation(
             op_name, buf, opts, watermark_fetcher=fetcher,
-            runner=self.executor.process, meta=meta,
+            runner=self._execute_within_deadline, meta=meta,
             frame_cache=frames, source_digest=digest,
         )
         # placement was recorded by submit() on THIS worker thread
         return out, last_placement()
+
+    def _execute_within_deadline(self, arr, plan):
+        """Executor.process with the device wait bounded by the request's
+        remaining budget: a future whose deadline passes while it sits in
+        the micro-batch queue (or mid-drain on a slow device) is cancelled
+        — releasing its owed-work ledger charge via the done-callback —
+        and the request 504s instead of riding out the full 120 s cap."""
+        dl = deadline_mod.current()
+        if dl is None:
+            return self.executor.process(arr, plan)
+        rem = dl.note("device_queue")
+        if rem <= 0.0:
+            raise dl.error("device_queue")
+        fut = self.executor.submit(arr, plan)
+        try:
+            return fut.result(timeout=rem)
+        except FuturesTimeout:
+            fut.cancel()  # queued: skipped at dispatch; running: result dropped
+            raise dl.error("device_execute") from None
 
 
 # --- simple controllers -------------------------------------------------------

@@ -50,6 +50,19 @@ NGHTTP2_INTERNAL_ERROR = 0x02
 
 # connection-specific headers that must not cross into HTTP/2
 # (RFC 9113 section 8.2.2)
+# Shutdown-drain flag, set by serve() when the stop signal lands: the h2
+# server has stopped ACCEPTING connections by then, but live connections
+# can still open new streams — those get a fast, well-formed 503 +
+# Retry-After (mirroring the h1 drain path in the trace middleware)
+# instead of racing the hop teardown into a bare 502.
+_DRAINING = False
+
+
+def set_draining(value: bool) -> None:
+    global _DRAINING
+    _DRAINING = bool(value)
+
+
 _HOP_HEADERS = {
     "connection", "keep-alive", "proxy-connection", "transfer-encoding",
     "upgrade", "te", "host",
@@ -391,6 +404,13 @@ class H2Protocol(asyncio.Protocol):
         )) or new_request_id()
         try:
             _dbg(f"dispatch sid={stream_id} body={len(st.body)}")
+            if _DRAINING:
+                self._submit_response(
+                    stream_id, st,
+                    [(":status", "503"), ("x-request-id", rid),
+                     ("retry-after", "2"), ("content-length", "0")], b"",
+                )
+                return
             pseudo = {n: v for n, v in st.headers if n.startswith(":")}
             method = pseudo.get(":method", "GET")
             path = pseudo.get(":path", "/")

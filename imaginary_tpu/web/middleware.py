@@ -18,6 +18,7 @@ from urllib.parse import urlencode
 
 from aiohttp import web
 
+from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu.obs import events as obs_events
 from imaginary_tpu.obs import histogram as obs_hist
 from imaginary_tpu.obs import trace as obs_trace
@@ -92,11 +93,14 @@ def error_response(request: web.Request, err: ImageError, o: ServerOptions) -> w
 
         resp = placeholder_response(request, err, o)
         if resp is not None:
+            if err.headers:
+                resp.headers.update(err.headers)
             return resp
     return web.Response(
         body=err.json_bytes(),
         status=err.http_code(),
         content_type="application/json",
+        headers=err.headers or None,
     )
 
 
@@ -131,11 +135,36 @@ def trace_middleware(o: ServerOptions, events_out=None):
             traceparent=request.headers.get("traceparent", ""),
             enabled=o.trace_enabled,
         )
+        # Mint the end-to-end deadline next to the request id: the budget
+        # is the server default, lowered (never raised) by the client's
+        # X-Request-Timeout header. It rides the trace contextvar so every
+        # hop — admission, fetch, coalesce wait, executor queue, pool,
+        # encode — reads remaining budget from one place (deadline.py).
+        budget = deadline_mod.resolve_budget(
+            o.request_timeout_s, request.headers.get("X-Request-Timeout", "")
+        )
+        if budget > 0.0:
+            tr.deadline = deadline_mod.Deadline(budget)
         token = obs_trace.activate(tr)
         t0 = time.monotonic()
         status = 500  # a non-HTTP exception books as a 500
         resp = None
         try:
+            if request.app.get("draining") and not is_public_path(o, request.path):
+                # shutdown drain: shed new image work fast with the same
+                # Retry-After contract the rate-limit/queue-full 503s honor
+                # (another instance behind the LB will take the retry);
+                # /health stays live so the balancer sees the drain itself
+                from imaginary_tpu.errors import new_error
+
+                resp = error_response(
+                    request,
+                    new_error("Server is shutting down, retry later", 503,
+                              headers={"Retry-After": "2"}),
+                    o,
+                )
+                status = resp.status
+                return resp
             resp = await handler(request)
             status = resp.status
             return resp
@@ -155,6 +184,17 @@ def trace_middleware(o: ServerOptions, events_out=None):
                     st = tr.server_timing()
                     if st:
                         resp.headers["Server-Timing"] = st
+            if tr.enabled and tr.deadline is not None:
+                # deadline state lands in the wide-event/slow-ring/debugz
+                # surfaces: the budget, what was left at the end, and the
+                # remaining-at-each-stage checkpoints every enforced hop
+                # recorded (deadline.py note/check)
+                dl = tr.deadline
+                tr.annotate(
+                    deadline_budget_ms=round(dl.budget_s * 1000.0, 1),
+                    deadline_remaining_ms=round(dl.remaining_s() * 1000.0, 1),
+                    deadline_stages=dl.stages_dict(),
+                )
             if tr.enabled:
                 event = tr.to_event(
                     method=request.method,
@@ -193,8 +233,15 @@ def _validate_request(o: ServerOptions):
     @web.middleware
     async def mw(request, handler):
         # GET/POST only (ref: middleware.go:179-187); OPTIONS passes only
-        # for CORS preflight
-        if request.method not in ("GET", "POST") and not (request.method == "OPTIONS" and o.cors):
+        # for CORS preflight, PUT only for the gated failpoint control
+        # surface (runtime chaos arming, obs/debugz.py)
+        if request.method not in ("GET", "POST") and not (
+            request.method == "OPTIONS" and o.cors
+        ) and not (
+            request.method == "PUT"
+            and o.enable_debug
+            and request.path.endswith("/debugz/failpoints")
+        ):
             return error_response(request, ErrMethodNotAllowed, o)
         return await handler(request)
 

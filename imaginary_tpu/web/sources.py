@@ -10,17 +10,33 @@ Deliberate fixes over the fork (SURVEY.md section 2.13): deterministic
 match order (body > fs > http instead of Go map iteration), full reads on
 file sources (no short-read risk), and the watermark-image fetch honors the
 origin allow-list instead of fetching any URL.
+
+Origin resilience (PARITY.md "Resilient origin fetches"): remote fetches
+run with per-ATTEMPT connect/read timeouts split out of the 60 s total,
+bounded retries (exponential backoff + full jitter) on connect errors,
+timeouts, 5xx and 429 — honoring the origin's Retry-After, never retrying
+other 4xx, never exceeding the request deadline — and honest status
+mapping: an origin timeout is OUR 504, a refused/failed connection OUR
+502, an origin error status OUR 502 with the origin's status in the
+message only (the reference re-raised the origin's status verbatim, which
+leaked e.g. an origin 401 as an imaginary-tpu auth failure). The HEAD
+size pre-check degrades to the size-capped GET on any failure instead of
+failing the request.
 """
 
 from __future__ import annotations
 
+import asyncio
 import os
+import random
 import urllib.parse
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
+from imaginary_tpu import deadline as deadline_mod
+from imaginary_tpu import failpoints
 from imaginary_tpu.errors import (
     ErrEntityTooLarge,
     ErrInvalidFilePath,
@@ -35,8 +51,11 @@ from imaginary_tpu.web.config import ServerOptions
 
 MAX_BODY_SIZE = 1 << 26  # 64 MB (ref: source_body.go:13)
 FORM_FIELD = "file"  # hard-coded upstream too (source_body.go:12)
-HTTP_TIMEOUT = 60  # seconds (ref: source_http.go:16)
+HTTP_TIMEOUT = 60  # seconds: per-attempt ceiling (ref: source_http.go:16)
 WATERMARK_MAX_BYTES = 1_000_000  # ref: image.go:352
+RETRY_BACKOFF_BASE_S = 0.1  # exponential base for attempt n: base * 2**n
+RETRY_BACKOFF_CAP_S = 2.0  # one sleep never exceeds this (full jitter below it)
+RETRY_AFTER_CAP_S = 10.0  # an origin demanding a longer pause isn't worth waiting on
 
 
 class BodyImageSource:
@@ -85,7 +104,9 @@ class BodyImageSource:
 
 class FileSystemImageSource:
     """GET ?file= under the -mount directory with traversal protection
-    (ref: source_fs.go:28-91)."""
+    (ref: source_fs.go:28-91). The read runs in a thread: a slow disk or
+    a hung NFS mount must stall THIS request, not every in-flight request
+    sharing the event loop."""
 
     name = "fs"
 
@@ -101,13 +122,65 @@ class FileSystemImageSource:
         path = os.path.normpath(os.path.join(self.mount, name.lstrip("/")))
         if not (path == self.mount or path.startswith(self.mount + os.sep)):
             raise ErrInvalidFilePath
-        try:
+
+        def _read() -> bytes:
             with open(path, "rb") as f:
                 return f.read()
+
+        try:
+            return await asyncio.to_thread(_read)
         except FileNotFoundError:
             raise ErrInvalidFilePath from None
         except IsADirectoryError:
             raise ErrInvalidFilePath from None
+
+
+class _OriginStatus(Exception):
+    """Internal: origin answered with a non-200; carries the status and
+    its Retry-After so the retry loop can classify/honor it."""
+
+    def __init__(self, status: int, retry_after_s: float = 0.0):
+        super().__init__(f"origin status {status}")
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+def _parse_retry_after(value: str) -> float:
+    """Delta-seconds form only (the HTTP-date form is rare from rate
+    limiters and not worth a date parser on the error path)."""
+    try:
+        return max(0.0, float(value.strip()))
+    except (ValueError, AttributeError):
+        return 0.0
+
+
+def _is_retryable_exc(e: BaseException) -> bool:
+    """Connect-class errors and timeouts are retryable: the request never
+    reached (or never finished reaching) an origin that processed it, so
+    a GET retry is safe. Response-status retryability is decided
+    separately (_OriginStatus)."""
+    return isinstance(e, (
+        asyncio.TimeoutError,
+        aiohttp.ClientConnectionError,  # covers connector/refused/reset/disconnect
+        aiohttp.ClientPayloadError,  # body cut mid-transfer
+        failpoints.FailpointError,
+        ConnectionError,
+    ))
+
+
+def _map_fetch_error(e: BaseException, url: str) -> ImageError:
+    """Honest status mapping for an exhausted/terminal fetch failure."""
+    if isinstance(e, asyncio.TimeoutError):
+        return new_error(
+            f"origin timed out fetching remote http image: (url={url})", 504)
+    if isinstance(e, _OriginStatus):
+        # the origin's status stays in the MESSAGE; ours is a gateway error
+        return new_error(
+            f"error fetching remote http image: origin answered "
+            f"status={e.status} (url={url})", 502)
+    return new_error(
+        f"error fetching remote http image: {str(e) or type(e).__name__} "
+        f"(url={url})", 502)
 
 
 class HTTPImageSource:
@@ -148,18 +221,62 @@ class HTTPImageSource:
             raise new_error(f"not allowed remote URL origin: {u.netloc}{u.path}", 400)
         return await self.fetch(raw, request)
 
+    # -- the resilient fetch path ------------------------------------------
+
+    def _attempt_timeout(self) -> aiohttp.ClientTimeout:
+        """Per-attempt budget: connect and total split out of HTTP_TIMEOUT,
+        both clipped to the request deadline's remaining budget so an
+        attempt can never outlive the request that wants its bytes."""
+        o = self.options
+        total = min(float(HTTP_TIMEOUT), max(o.source_read_timeout_s, 0.001))
+        connect = max(min(o.source_connect_timeout_s, total), 0.001)
+        dl = deadline_mod.current()
+        if dl is not None:
+            rem = max(dl.remaining_s(), 0.001)
+            total = min(total, rem)
+            connect = min(connect, rem)
+        return aiohttp.ClientTimeout(total=total, sock_connect=connect)
+
+    async def _fetch_once(self, sess, url: str, headers: dict,
+                          max_size: int) -> bytes:
+        """One GET attempt. Raises _OriginStatus on a non-200 answer and
+        lets network/timeout exceptions propagate for classification."""
+        await failpoints.ahit("source.fetch")
+        async with sess.get(url, headers=headers,
+                            timeout=self._attempt_timeout()) as res:
+            if res.status != 200:
+                raise _OriginStatus(
+                    res.status,
+                    _parse_retry_after(res.headers.get("Retry-After", "")),
+                )
+            data = bytearray()
+            async for chunk in res.content.iter_chunked(1 << 16):
+                data.extend(chunk)
+                if max_size and len(data) > max_size:
+                    # Deliberate parity divergence (PARITY.md §2.5-2.8):
+                    # the reference's LimitReader silently truncates an
+                    # oversize body and hands the pipeline corrupt image
+                    # bytes; rejecting is the only honest rendering.
+                    raise ErrEntityTooLarge
+            return bytes(data)
+
     async def fetch(self, url: str, request: Optional[web.Request],
                     limit: Optional[int] = None) -> bytes:
         sess = await self.session()
         headers = self._build_headers(request)
         # TTL'd source cache: keyed by URL + the exact header set the
         # origin would see (auth forwarding means two users can receive
-        # different bytes for one URL — they must not share an entry)
+        # different bytes for one URL — they must not share an entry).
+        # A failing cache tier degrades to a miss (failpoints cache.get
+        # proves it): slow is better than down.
         ckey = None
         caches = self._caches
         if caches is not None and caches.source.enabled:
             ckey = (url, limit, tuple(sorted(headers.items())))
-            hit = caches.source.get(ckey)
+            try:
+                hit = caches.source.get(ckey)
+            except Exception:
+                hit = None
             if hit is not None:
                 caches.stats.source_hits += 1
                 return hit
@@ -176,50 +293,73 @@ class HTTPImageSource:
         max_size = limit or self.options.max_allowed_size
         if self.options.max_allowed_size > 0 and limit is None:
             await self._check_size(sess, url, headers)
-        try:
-            async with sess.get(url, headers=headers) as res:
-                if res.status != 200:
-                    raise new_error(
-                        f"error fetching remote http image: (status={res.status}) (url={url})",
-                        res.status,
-                    )
-                data = bytearray()
-                async for chunk in res.content.iter_chunked(1 << 16):
-                    data.extend(chunk)
-                    if max_size and len(data) > max_size:
-                        # Deliberate parity divergence (PARITY.md §2.5-2.8):
-                        # the reference's LimitReader silently truncates an
-                        # oversize body and hands the pipeline corrupt image
-                        # bytes; rejecting is the only honest rendering.
-                        raise ErrEntityTooLarge
-                body = bytes(data)
-                if ckey is not None:
-                    caches.source.put(ckey, body, len(body))
-                return body
-        except ImageError:
-            raise
-        except Exception as e:
-            raise new_error(f"error fetching remote http image: {e}", 400) from None
+
+        retries = max(0, self.options.source_retries)
+        dl = deadline_mod.current()
+        attempt = 0
+        while True:
+            if dl is not None and dl.note("fetch") <= 0.0:
+                raise dl.error("fetch")
+            try:
+                body = await self._fetch_once(sess, url, headers, max_size)
+            except ImageError:
+                raise  # 413 oversize etc.: policy errors, never retried
+            except (Exception, asyncio.TimeoutError) as e:
+                retry_after = 0.0
+                if isinstance(e, _OriginStatus):
+                    # retry only what plausibly heals: 5xx and 429. Other
+                    # 4xx means the origin UNDERSTOOD and refused — a
+                    # retry would just hammer it.
+                    if not (e.status >= 500 or e.status == 429):
+                        raise _map_fetch_error(e, url) from None
+                    retry_after = min(e.retry_after_s, RETRY_AFTER_CAP_S)
+                elif not _is_retryable_exc(e):
+                    raise _map_fetch_error(e, url) from None
+                if attempt >= retries:
+                    raise _map_fetch_error(e, url) from None
+                # exponential backoff with FULL jitter (decorrelates a
+                # thundering herd of coalesced misses), floored by the
+                # origin's own Retry-After when it sent one
+                delay = random.uniform(
+                    0.0, min(RETRY_BACKOFF_BASE_S * (2 ** attempt),
+                             RETRY_BACKOFF_CAP_S))
+                delay = max(delay, retry_after)
+                if dl is not None and delay >= dl.remaining_s():
+                    # the budget can't absorb the wait: surface the origin
+                    # failure now instead of eating the rest of the budget
+                    raise _map_fetch_error(e, url) from None
+                attempt += 1
+                await asyncio.sleep(delay)
+                continue
+            if ckey is not None:
+                caches.source.put(ckey, body, len(body))
+            return body
 
     async def _check_size(self, sess, url: str, headers: dict):
-        """HEAD pre-check (ref: source_http.go:105-124, accepts 200-206)."""
+        """HEAD pre-check (ref: source_http.go:105-124, accepts 200-206).
+
+        Advisory, not load-bearing: an origin that answers the HEAD with
+        garbage, an error status, or not at all simply DEGRADES to the
+        size-capped GET (whose streaming cap enforces the same budget the
+        pre-check fronts for). Only a well-formed HEAD that proves the
+        body oversize fails the request — as 413, matching the GET-side
+        cap, not the old 400."""
         try:
-            async with sess.head(url, headers=headers) as res:
+            await failpoints.ahit("source.head")
+            async with sess.head(url, headers=headers,
+                                 timeout=self._attempt_timeout()) as res:
                 if res.status < 200 or res.status > 206:
-                    raise new_error(
-                        f"invalid status checking image size: (status={res.status}) (url={url})",
-                        res.status,
-                    )
+                    return  # odd status: let the GET (and its cap) decide
                 length = res.headers.get("Content-Length")
                 if length and int(length) > self.options.max_allowed_size:
                     raise new_error(
                         f"content length {length} exceeds maximum allowed "
-                        f"{self.options.max_allowed_size} bytes", 400,
+                        f"{self.options.max_allowed_size} bytes", 413,
                     )
         except ImageError:
             raise
-        except Exception as e:
-            raise new_error(f"error checking image size: {e}", 400) from None
+        except Exception:
+            return  # network/timeout/injected fault: degrade to the GET
 
     def _build_headers(self, request: Optional[web.Request]) -> dict:
         headers = {"User-Agent": f"imaginary-tpu/{Version}"}

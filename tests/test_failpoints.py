@@ -1,0 +1,450 @@
+"""Failpoint harness tests (imaginary_tpu/failpoints.py) + the chaos
+scenarios ISSUE-4 names: every injection site reachable, flaky origin
+converging through retries, dead origin mapping to 502 within budget,
+faults mid-coalesce fanning out to all waiters, breaker failover under
+injected device errors, and cache faults degrading to misses — with the
+harness itself provably free when disarmed."""
+
+import asyncio
+import time
+
+import pytest
+
+from imaginary_tpu import failpoints
+from imaginary_tpu.web.config import ServerOptions
+from tests.conftest import fixture_bytes
+from tests.test_server import multipart_jpg, run
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    failpoints.deactivate()
+    yield
+    failpoints.deactivate()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+class TestSpecParsing:
+    def test_basic_clauses(self):
+        parsed = failpoints.parse(
+            "source.fetch=error(0.5);device.execute=delay(200ms)")
+        assert parsed["source.fetch"].kind == "error"
+        assert parsed["source.fetch"].p == 0.5
+        assert parsed["device.execute"].kind == "delay"
+        assert parsed["device.execute"].duration_s == pytest.approx(0.2)
+
+    def test_error_defaults_p1(self):
+        assert failpoints.parse("codec.decode=error")["codec.decode"].p == 1.0
+
+    def test_durations(self):
+        assert failpoints.parse("cache.get=delay(1.5s)")["cache.get"].duration_s == 1.5
+        assert failpoints.parse("cache.get=timeout(50ms)")["cache.get"].duration_s == 0.05
+        assert failpoints.parse("cache.get=timeout")["cache.get"].duration_s == 60.0
+
+    def test_once_wrapper(self):
+        sp = failpoints.parse("source.fetch=once(error)")["source.fetch"]
+        assert sp.kind == "error" and sp.once
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown failpoint site"):
+            failpoints.parse("bogus.site=error")
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown failpoint action"):
+            failpoints.parse("source.fetch=explode")
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            failpoints.parse("source.fetch")
+        with pytest.raises(ValueError):
+            failpoints.parse("source.fetch=delay")  # delay needs a duration
+        with pytest.raises(ValueError):
+            failpoints.parse("source.fetch=error(2.0)")  # p outside [0,1]
+        with pytest.raises(ValueError):
+            failpoints.parse("source.fetch=delay(10)")  # unit required
+
+    def test_empty_spec_disarms(self):
+        failpoints.activate("source.fetch=error")
+        failpoints.activate("")
+        assert not failpoints.snapshot()["enabled"]
+
+    def test_active_spec_round_trips(self):
+        spec = "source.fetch=error(0.5);device.execute=delay(200ms)"
+        failpoints.activate(spec)
+        assert failpoints.parse(failpoints.active_spec()).keys() == \
+            failpoints.parse(spec).keys()
+
+    def test_activate_from_env(self):
+        assert not failpoints.activate_from_env({"OTHER": "x"})
+        assert failpoints.activate_from_env(
+            {failpoints.ENV_VAR: "codec.encode=error"})
+        assert failpoints.snapshot()["sites"]["codec.encode"]["action"] == "error"
+
+    def test_bad_env_spec_fails_loudly(self):
+        with pytest.raises(ValueError):
+            failpoints.activate_from_env({failpoints.ENV_VAR: "nope=error"})
+
+
+class TestActionsAndOverhead:
+    def test_disarmed_is_noop(self):
+        failpoints.hit("source.fetch")  # nothing raised
+        asyncio.run(failpoints.ahit("source.fetch"))
+
+    def test_disarmed_overhead_negligible(self):
+        """The off path is one falsy-dict check: 200k calls must be far
+        under human-visible time (generous bound for noisy CI hosts)."""
+        t0 = time.monotonic()
+        for _ in range(200_000):
+            failpoints.hit("codec.decode")
+        assert time.monotonic() - t0 < 1.0
+
+    def test_error_raises(self):
+        failpoints.activate("codec.decode=error")
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.hit("codec.decode")
+        # other sites untouched
+        failpoints.hit("codec.encode")
+
+    def test_error_probability_zero_never_fires(self):
+        failpoints.activate("codec.decode=error(0.0)")
+        for _ in range(100):
+            failpoints.hit("codec.decode")
+        snap = failpoints.snapshot()["sites"]["codec.decode"]
+        assert snap["hits"] == 100 and snap["fired"] == 0
+
+    def test_once_fires_exactly_once(self):
+        failpoints.activate("codec.decode=once(error)")
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.hit("codec.decode")
+        failpoints.hit("codec.decode")  # spent: no-op
+        snap = failpoints.snapshot()
+        assert snap["sites"]["codec.decode"]["fired"] == 1
+
+    def test_delay_sleeps_then_continues(self):
+        failpoints.activate("codec.decode=delay(50ms)")
+        t0 = time.monotonic()
+        failpoints.hit("codec.decode")  # no raise
+        assert time.monotonic() - t0 >= 0.045
+
+    def test_timeout_sync_raises_timeout_error(self):
+        failpoints.activate("codec.decode=timeout(10ms)")
+        with pytest.raises(TimeoutError):
+            failpoints.hit("codec.decode")
+
+    def test_timeout_async_raises_asyncio_timeout(self):
+        failpoints.activate("source.fetch=timeout(10ms)")
+        with pytest.raises(asyncio.TimeoutError):
+            asyncio.run(failpoints.ahit("source.fetch"))
+
+
+class TestEverySiteReachable:
+    """Arm each site with error(1.0) and observe its documented effect
+    through the real serving stack — reachability AND the degradation
+    policy at that boundary."""
+
+    def test_source_fetch_site(self):
+        from aiohttp import web as aioweb
+
+        async def origin(request):
+            return aioweb.Response(body=fixture_bytes("imaginary.jpg"),
+                                   content_type="image/jpeg")
+
+        failpoints.activate("source.fetch=once(error)")
+
+        async def fn(client, origin_url):
+            # first attempt eats the injected fault; the retry serves
+            res = await client.get(f"/resize?width=100&url={origin_url}/i.jpg")
+            assert res.status == 200
+            assert failpoints.snapshot()["sites"]["source.fetch"]["fired"] == 1
+
+        run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
+
+    def test_source_head_site_degrades(self):
+        from aiohttp import web as aioweb
+
+        async def origin(request):
+            return aioweb.Response(body=fixture_bytes("imaginary.jpg"),
+                                   content_type="image/jpeg")
+
+        failpoints.activate("source.head=error")
+
+        async def fn(client, origin_url):
+            # HEAD pre-check faulted -> size-capped GET serves anyway
+            res = await client.get(f"/resize?width=100&url={origin_url}/i.jpg")
+            assert res.status == 200
+            assert failpoints.snapshot()["sites"]["source.head"]["fired"] >= 1
+
+        run(ServerOptions(enable_url_source=True, max_allowed_size=10_000_000),
+            fn, origin_handler=origin)
+
+    def test_codec_decode_site(self):
+        failpoints.activate("codec.decode=error")
+
+        async def fn(client, _):
+            res = await client.post("/resize?width=100",
+                                    data=fixture_bytes("imaginary.jpg"))
+            assert res.status == 400
+            body = await res.json()
+            assert "injected error" in body["message"]
+
+        run(ServerOptions(), fn)
+
+    def test_executor_submit_site(self):
+        failpoints.activate("executor.submit=error")
+
+        async def fn(client, _):
+            res = await client.post("/resize?width=100",
+                                    data=fixture_bytes("imaginary.jpg"))
+            assert res.status == 400
+
+        run(ServerOptions(), fn)
+
+    def test_device_execute_site_trips_breaker_to_host(self):
+        """Injected device failures exercise the availability story
+        end-to-end: errors surface per-request until the breaker's
+        consecutive-failure threshold, then host failover serves 200s."""
+        failpoints.activate("device.execute=error")
+
+        async def fn(client, _):
+            svc = client.app["service"]
+            statuses = []
+            for _ in range(6):
+                res = await client.post("/resize?width=100",
+                                        data=fixture_bytes("imaginary.jpg"))
+                statuses.append(res.status)
+                if res.status == 200:
+                    assert res.headers.get("X-Imaginary-Backend") == "host"
+                    break
+            assert statuses[-1] == 200, statuses
+            assert all(s == 400 for s in statuses[:-1]), statuses
+            assert svc.executor.stats.breaker_opens >= 1
+            assert svc.executor.stats.breaker_host_served >= 1
+
+        run(ServerOptions(), fn)
+
+    def test_host_spill_site_falls_back_to_device(self):
+        """A faulted spill must not fail the request: it books a spill
+        error and rides the device path."""
+        failpoints.activate("host.spill=error")
+
+        async def fn(client, _):
+            svc = client.app["service"]
+            res = await client.post("/resize?width=100",
+                                    data=fixture_bytes("imaginary.jpg"))
+            assert res.status == 200
+            assert res.headers.get("X-Imaginary-Backend") == "device"
+            assert svc.executor.stats.spill_errors >= 1
+
+        run(ServerOptions(force_host=True), fn)
+
+    def test_codec_encode_site(self):
+        failpoints.activate("codec.encode=error")
+
+        async def fn(client, _):
+            res = await client.post("/resize?width=100",
+                                    data=fixture_bytes("imaginary.jpg"))
+            assert res.status == 400
+
+        run(ServerOptions(), fn)
+
+    def test_cache_get_site_degrades_to_miss(self):
+        """A failing cache tier costs latency, never availability: both
+        the cold and would-be-hot request serve 200."""
+        failpoints.activate("cache.get=error")
+
+        async def fn(client, _):
+            for _ in range(2):
+                res = await client.post("/resize?width=100",
+                                        data=multipart_jpg())
+                assert res.status == 200
+            assert failpoints.snapshot()["sites"]["cache.get"]["fired"] >= 2
+
+        run(ServerOptions(cache_result_mb=8.0, cache_frame_mb=8.0), fn)
+
+
+class TestChaosScenarios:
+    def test_flaky_origin_retries_converge(self):
+        """source.fetch=error(0.5) with a retry budget: the overwhelming
+        majority of requests converge to 2xx (per-request failure odds
+        with 4 retries: 0.5^5 ~= 3%)."""
+        from aiohttp import web as aioweb
+
+        async def origin(request):
+            return aioweb.Response(body=fixture_bytes("imaginary.jpg"),
+                                   content_type="image/jpeg")
+
+        failpoints.activate("source.fetch=error(0.5)")
+
+        async def fn(client, origin_url):
+            statuses = []
+            for _ in range(20):
+                res = await client.get(
+                    f"/resize?width=100&url={origin_url}/i.jpg")
+                statuses.append(res.status)
+            ok = sum(1 for s in statuses if s == 200)
+            assert ok >= 15, statuses
+            assert all(s in (200, 502) for s in statuses), statuses
+
+        run(ServerOptions(enable_url_source=True, source_retries=4),
+            fn, origin_handler=origin)
+
+    def test_dead_origin_502_within_budget(self):
+        """error(1.0): retries exhaust, the request maps to 502 (not the
+        old blanket 400), inside the request deadline."""
+        from aiohttp import web as aioweb
+
+        async def origin(request):
+            return aioweb.Response(body=fixture_bytes("imaginary.jpg"),
+                                   content_type="image/jpeg")
+
+        failpoints.activate("source.fetch=error")
+
+        async def fn(client, origin_url):
+            t0 = time.monotonic()
+            res = await client.get(f"/resize?width=100&url={origin_url}/i.jpg")
+            elapsed = time.monotonic() - t0
+            assert res.status == 502
+            body = await res.json()
+            assert "injected error" in body["message"]
+            assert elapsed < 2.0
+
+        run(ServerOptions(enable_url_source=True, request_timeout_s=2.0),
+            fn, origin_handler=origin)
+
+    def test_origin_timeout_maps_to_504(self):
+        failpoints.activate("source.fetch=timeout(10ms)")
+
+        from aiohttp import web as aioweb
+
+        async def origin(request):
+            return aioweb.Response(body=b"unreached")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?width=100&url={origin_url}/i.jpg")
+            assert res.status == 504
+            body = await res.json()
+            assert "timed out" in body["message"]
+
+        run(ServerOptions(enable_url_source=True, source_retries=1),
+            fn, origin_handler=origin)
+
+    def test_fault_mid_coalesce_fans_out_to_all_waiters(self):
+        """N concurrent identical requests coalesce onto one run; an
+        injected decode fault must fan the SAME error out to every waiter
+        — no hangs, no stragglers, and the group ledger drains."""
+        failpoints.activate("codec.decode=error")
+
+        async def fn(client, _):
+            svc = client.app["service"]
+            blob = fixture_bytes("imaginary.jpg")
+
+            async def one():
+                res = await client.post("/resize?width=100", data=blob)
+                return res.status, (await res.json())["message"]
+
+            results = await asyncio.gather(*[one() for _ in range(8)])
+            assert all(status == 400 for status, _ in results), results
+            assert all("injected error" in msg for _, msg in results)
+            # the coalescer's group map drained (no leaked groups)
+            assert svc.caches.flight.inflight() == 0
+
+        run(ServerOptions(cache_coalesce=True), fn)
+
+    def test_breaker_invariants_under_concurrent_chaos(self):
+        """Concurrent traffic against a dead device: every request
+        resolves (400 until the breaker opens, then host-served 200),
+        nothing hangs, and the gate/ledger counters return to rest."""
+        failpoints.activate("device.execute=error")
+
+        async def fn(client, _):
+            svc = client.app["service"]
+            blob = fixture_bytes("imaginary.jpg")
+
+            async def one(i):
+                res = await client.post(f"/resize?width=10{i % 3}", data=blob)
+                return res.status
+
+            statuses = await asyncio.gather(*[one(i) for i in range(12)])
+            assert all(s in (200, 400) for s in statuses), statuses
+            assert 200 in statuses  # breaker failover engaged
+            # ledgers at rest once traffic stops
+            for _ in range(50):
+                with svc._inflight_lock:
+                    if svc._inflight == 0:
+                        break
+                await asyncio.sleep(0.02)
+            with svc._inflight_lock:
+                assert svc._inflight == 0
+            assert svc.executor.estimated_wait_ms() == pytest.approx(0.0, abs=1e-6)
+
+        run(ServerOptions(), fn)
+
+
+class TestDebugzControlSurface:
+    def test_get_put_round_trip(self):
+        async def fn(client, _):
+            # arm at runtime
+            res = await client.put("/debugz/failpoints",
+                                   data="codec.decode=error")
+            assert res.status == 200
+            body = await res.json()
+            assert body["enabled"] and "codec.decode" in body["sites"]
+
+            bad = await client.post("/resize?width=100",
+                                    data=fixture_bytes("imaginary.jpg"))
+            assert bad.status == 400
+
+            # observe counters, then disarm with an empty PUT
+            res = await client.get("/debugz/failpoints")
+            snap = await res.json()
+            assert snap["sites"]["codec.decode"]["fired"] >= 1
+
+            res = await client.put("/debugz/failpoints", data="")
+            assert (await res.json())["enabled"] is False
+
+            ok = await client.post("/resize?width=100",
+                                   data=fixture_bytes("imaginary.jpg"))
+            assert ok.status == 200
+
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_bad_spec_rejected_400(self):
+        async def fn(client, _):
+            res = await client.put("/debugz/failpoints", data="nope=error")
+            assert res.status == 400
+            assert "unknown failpoint site" in (await res.json())["error"]
+
+        run(ServerOptions(enable_debug=True), fn)
+
+    def test_gated_behind_enable_debug(self):
+        async def fn(client, _):
+            res = await client.get("/debugz/failpoints")
+            assert res.status == 404
+            res = await client.put("/debugz/failpoints", data="codec.decode=error")
+            assert res.status == 405  # PUT never even validates when gated
+
+        run(ServerOptions(), fn)
+
+    def test_env_arming_through_create_app(self, monkeypatch):
+        monkeypatch.setenv(failpoints.ENV_VAR, "codec.encode=error(0.0)")
+
+        async def fn(client, _):
+            assert failpoints.snapshot()["enabled"]
+            assert "codec.encode" in failpoints.snapshot()["sites"]
+
+        run(ServerOptions(), fn)
+
+    def test_failpoints_in_debugz_payload(self):
+        failpoints.activate("codec.decode=error(0.0)")
+
+        async def fn(client, _):
+            res = await client.get("/debugz")
+            body = await res.json()
+            assert body["failpoints"]["enabled"]
+            assert "codec.decode" in body["failpoints"]["sites"]
+
+        run(ServerOptions(enable_debug=True), fn)

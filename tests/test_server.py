@@ -275,7 +275,11 @@ class TestURLSource:
 
         run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
 
-    def test_origin_error_propagates(self):
+    def test_origin_error_maps_to_502(self):
+        """An origin error is OUR gateway failure, not the client's fault:
+        the origin's status stays in the message only (PARITY.md r8 — the
+        reference re-raised it verbatim, leaking e.g. an origin 401 as an
+        imaginary-tpu auth failure)."""
         from aiohttp import web
 
         async def origin(request):
@@ -283,7 +287,9 @@ class TestURLSource:
 
         async def fn(client, origin_url):
             res = await client.get(f"/resize?url={origin_url}/gone.jpg&width=300")
-            assert res.status == 404
+            assert res.status == 502
+            body = await res.json()
+            assert "status=404" in body["message"]
 
         run(ServerOptions(enable_url_source=True), fn, origin_handler=origin)
 
@@ -764,6 +770,8 @@ class TestQueueDepthAdmission:
             assert resp.status == 503
             body = await resp.json()
             assert body["message"] == "Server queue is full, retry later"
+            # the shed carries a backoff hint like the rate-limit 503 (r8)
+            assert int(resp.headers["Retry-After"]) >= 1
 
         run(ServerOptions(max_queue_ms=200.0), fn)
 
@@ -784,6 +792,21 @@ class TestQueueDepthAdmission:
                 "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
             assert resp.status == 200  # 0 = no depth gate (r4 behavior)
             svc._inflight = 0
+
+        run(ServerOptions(), fn)
+
+    def test_shutdown_drain_sheds_with_retry_after(self):
+        """During the shutdown grace window new image work 503s fast with
+        a Retry-After (another instance takes the retry); /health stays
+        live so the balancer can see the drain."""
+        async def fn(client, _):
+            client.app["draining"] = True
+            resp = await client.post(
+                "/resize?width=100", data=fixture_bytes("imaginary.jpg"))
+            assert resp.status == 503
+            assert resp.headers["Retry-After"] == "2"
+            health = await client.get("/health")
+            assert health.status == 200
 
         run(ServerOptions(), fn)
 
@@ -1066,7 +1089,8 @@ class TestMaxAllowedSize:
 
         async def fn(client, origin_url):
             res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
-            assert res.status == 400
+            # 413 to match the GET-side streaming cap (r8; was 400)
+            assert res.status == 413
             body = await res.json()
             assert "exceeds maximum allowed" in body["message"]
 
@@ -1089,8 +1113,10 @@ class TestMaxAllowedSize:
                           max_allowed_size=len(blob) + 100),
             fn, origin_handler=origin)
 
-    def test_head_status_outside_200_206_rejected(self):
-        """the pre-check accepts 200-206 only (source_http.go:105-124)."""
+    def test_head_failure_degrades_to_capped_get(self):
+        """The HEAD pre-check is advisory (r8): an origin that errors the
+        HEAD (many CDNs 403 it) degrades to the size-capped GET instead of
+        failing a request the GET path can serve."""
         from aiohttp import web
 
         async def origin(request):
@@ -1101,7 +1127,27 @@ class TestMaxAllowedSize:
 
         async def fn(client, origin_url):
             res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
-            assert res.status == 403
+            assert res.status == 200
 
         run(ServerOptions(enable_url_source=True, max_allowed_size=10_000_000),
+            fn, origin_handler=origin)
+
+    def test_head_oversize_still_capped_by_get(self):
+        """A lying/failed HEAD cannot bypass the size budget: the GET-side
+        streaming cap still rejects an oversize body with 413."""
+        from aiohttp import web
+
+        blob = fixture_bytes("1024bytes")
+
+        async def origin(request):
+            if request.method == "HEAD":
+                return web.Response(status=500)
+            return web.Response(body=blob,
+                                content_type="application/octet-stream")
+
+        async def fn(client, origin_url):
+            res = await client.get(f"/resize?url={origin_url}/img.jpg&width=100")
+            assert res.status == 413
+
+        run(ServerOptions(enable_url_source=True, max_allowed_size=1023),
             fn, origin_handler=origin)
