@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs bench-deadline chaos serve clean gate lint
+.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos chaos serve clean gate lint
 
 all: native test
 
@@ -19,7 +19,9 @@ gate: lint test chaos
 	  { echo "bench_obs.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_deadline.py || \
 	  { echo "bench_deadline.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline benches all pass"
+	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_qos.py || \
+	  { echo "bench_qos.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline/qos benches all pass"
 
 # Chaos drill (ISSUE 4): the deadline + failpoint suites, then a short
 # firehose soak with a flaky origin injected (source.fetch=error(0.2))
@@ -27,7 +29,7 @@ gate: lint test chaos
 # boundedness, and ledgers at rest. The failure modes the breaker/gate/
 # retry machinery exists for, exercised on every gate run.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py -q
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py -q
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 python bench_chaos.py || \
 	  { echo "chaos soak failed - resilience invariants violated"; exit 1; }
 
@@ -69,6 +71,12 @@ bench-obs:
 # exits nonzero on gross overhead or any spurious shed/expiry
 bench-deadline:
 	python bench_deadline.py
+
+# mixed-tenant overload isolation row (hog batch flood vs interactive
+# tenant p99, qos on/off + unloaded anchor); exits nonzero when qos fails
+# to improve the interactive p99 or breaches the isolation bound
+bench-qos:
+	python bench_qos.py
 
 docker:
 	docker build -t imaginary-tpu .

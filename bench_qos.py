@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Multi-tenant QoS isolation benchmark: the row ISSUE-5's tentpole is
+graded on.
+
+An ABBA mixed-tenant OVERLOAD row: a hog tenant (priority class `batch`,
+queue share capped) floods enlarge requests while a small interactive
+tenant issues resizes, closed-loop, against one server. Two arms on the
+same host:
+
+  * qos OFF (--qos-config unset: the parity default — one FIFO intake,
+    the hog's backlog IS the interactive tenant's queue)
+  * qos ON  (tenant table below: interactive dispatches ahead of batch
+    in the executor's fair scheduler, and the hog may hold at most two
+    slots of the intake queue — its overflow sheds 503 instead of
+    queueing)
+
+plus an UNLOADED reference arm (interactive swarm alone) that anchors the
+isolation bound. Host spill is pinned off in every arm so all work rides
+the executor queue — the subsystem under test — rather than whatever mix
+the spill cost model would choose on this host. The hog enlarges SMALL
+sources (320x240 -> 960x720) from many clients rather than a few 4K
+monsters: scheduling can only reorder work that is WAITING, so the
+overload must live as a deep intake backlog (where priority and share
+caps act), not inside one multi-second device call that nothing can
+preempt — the latter measures the batch, not the scheduler.
+
+Prints one JSON line on stdout; human detail on stderr. Exits nonzero
+when the interactive tenant's p99 with qos ON fails to improve on qos
+OFF (beyond BENCH_QOS_TOLERANCE_PCT slack, default 10 — short-run noise
+guard), when it exceeds BENCH_QOS_ISOLATION_FACTOR x its unloaded p99
+(default 25), or when the ON arm adds interactive errors (the protected
+tenant must never be the one shed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import sys
+import time
+
+import aiohttp
+
+from bench_cache import _start_origin, _start_server
+from bench_util import ensure_native_built, make_1080p_jpeg, pctl
+
+N_URLS = 16  # distinct source digests (smaller than bench_cache's 64:
+#              every request decodes anyway — caches are off — and fewer
+#              variants keep origin memory flat across the 5 arms)
+
+
+def make_small_jpeg(width: int = 320, height: int = 240) -> bytes:
+    """The hog's enlarge source: the bench 1080p image downscaled, so it
+    compresses/decodes like a photo but each enlarge is cheap enough that
+    overload shows up as QUEUE DEPTH, not one endless device call."""
+    import cv2
+    import numpy as np
+
+    img = cv2.imdecode(np.frombuffer(make_1080p_jpeg(), np.uint8),
+                       cv2.IMREAD_COLOR)
+    small = cv2.resize(img, (width, height), interpolation=cv2.INTER_AREA)
+    ok, out = cv2.imencode(".jpg", small,
+                           [int(cv2.IMWRITE_JPEG_QUALITY), 88])
+    assert ok
+    return out.tobytes()
+
+# hog share: 1/32 of a 64-slot intake queue = 2 items — the flood's
+# overflow sheds 503 at submit instead of becoming everyone's backlog
+QOS_CFG = json.dumps({
+    "default": {"class": "standard"},
+    "tenants": [
+        {"name": "gold", "class": "interactive", "api_keys": ["gold-key"]},
+        {"name": "hog", "class": "batch", "api_keys": ["hog-key"],
+         "max_share": 0.03125},
+    ],
+    "queue_cap": 64,
+})
+
+
+async def _swarm(session, urls, headers, concurrency, duration, lats, codes):
+    """Closed-loop client swarm; appends latencies of 200s to `lats` and
+    counts every status (or 'exc') in `codes`."""
+    deadline = time.monotonic() + duration
+
+    async def worker():
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            try:
+                async with session.get(next(urls), headers=headers) as res:
+                    await res.read()
+                    codes[res.status] = codes.get(res.status, 0) + 1
+                    if res.status != 200:
+                        continue
+            except Exception:
+                codes["exc"] = codes.get("exc", 0) + 1
+                continue
+            lats.append((time.monotonic() - t0) * 1000.0)
+
+    await asyncio.gather(*[worker() for _ in range(concurrency)])
+
+
+async def _arm(qos_on: bool, variants, duration: float, hog_conc: int,
+               gold_conc: int, with_hog: bool = True):
+    """One measurement slice. Returns (gold_lats, gold_codes, hog_codes)."""
+    from imaginary_tpu.web.config import ServerOptions
+
+    opts = ServerOptions(enable_url_source=True, host_spill=False,
+                         qos_config=QOS_CFG if qos_on else "")
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(opts)
+    try:
+        # variants[0:N] are the gold 1080p sources, variants[N:2N] the
+        # hog's small enlarge sources (one origin, disjoint digests)
+        gold_urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in range(N_URLS)
+        ])
+        hog_urls = itertools.cycle([
+            f"{base}/enlarge?width=960&height=720&url={origin_base}/img/{i}"
+            for i in range(N_URLS, 2 * N_URLS)
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        gold_lats: list = []
+        gold_codes: dict = {}
+        hog_codes: dict = {}
+        async with aiohttp.ClientSession(connector=conn) as session:
+            # warmup outside the timed window: XLA compiles for both
+            # chain shapes + first origin fetches (compile cache is
+            # process-global, so later arms start warm — the ABBA order
+            # cancels what little asymmetry remains)
+            warm = [session.get(next(gold_urls),
+                                headers={"API-Key": "gold-key"})
+                    for _ in range(2)]
+            if with_hog:
+                warm += [session.get(next(hog_urls),
+                                     headers={"API-Key": "hog-key"})
+                         for _ in range(2)]
+            for fut in warm:
+                async with await fut as r:
+                    await r.read()
+            swarms = [_swarm(session, gold_urls, {"API-Key": "gold-key"},
+                             gold_conc, duration, gold_lats, gold_codes)]
+            if with_hog:
+                swarms.append(_swarm(session, hog_urls,
+                                     {"API-Key": "hog-key"}, hog_conc,
+                                     duration, [], hog_codes))
+            await asyncio.gather(*swarms)
+        return gold_lats, gold_codes, hog_codes
+    finally:
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+
+
+def _errs(codes: dict) -> int:
+    return sum(v for k, v in codes.items() if k != 200)
+
+
+def main() -> int:
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "8"))
+    hog_conc = int(os.environ.get("BENCH_CONCURRENCY", "16"))
+    gold_conc = max(2, hog_conc // 4)
+    tolerance = float(os.environ.get("BENCH_QOS_TOLERANCE_PCT", "10"))
+    iso_factor = float(os.environ.get("BENCH_QOS_ISOLATION_FACTOR", "25"))
+
+    base_jpeg = make_1080p_jpeg()
+    small_jpeg = make_small_jpeg()
+    variants = ([base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+                + [small_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)])
+
+    print(f"[qos-bench] hog flood ({hog_conc} batch enlarge clients) vs "
+          f"interactive tenant ({gold_conc} resize clients), qos on/off, "
+          f"{duration}s per arm, ABBA-interleaved", file=sys.stderr)
+
+    # unloaded reference: the interactive swarm alone, qos off
+    u_lats, u_codes, _ = asyncio.run(_arm(
+        False, variants, max(duration / 2.0, 1.0), hog_conc, gold_conc,
+        with_hog=False))
+    p99_unloaded = pctl(u_lats, 0.99)
+    print(f"[qos-bench] unloaded interactive p99 {p99_unloaded:.1f} ms "
+          f"({len(u_lats)} reqs)", file=sys.stderr)
+
+    slice_s = max(duration / 2.0, 1.0)
+    totals = {True: [[], {}, {}], False: [[], {}, {}]}  # lats, gold, hog
+    for arm_on in (False, True, True, False):
+        lats, gold_codes, hog_codes = asyncio.run(_arm(
+            arm_on, variants, slice_s, hog_conc, gold_conc))
+        totals[arm_on][0].extend(lats)
+        for codes, acc in ((gold_codes, totals[arm_on][1]),
+                           (hog_codes, totals[arm_on][2])):
+            for k, v in codes.items():
+                acc[k] = acc.get(k, 0) + v
+
+    lats_off, gold_off, hog_off = totals[False]
+    lats_on, gold_on, hog_on = totals[True]
+    p99_off, p99_on = pctl(lats_off, 0.99), pctl(lats_on, 0.99)
+    p50_off, p50_on = pctl(lats_off, 0.50), pctl(lats_on, 0.50)
+    improvement = (100.0 * (p99_off - p99_on) / p99_off) if p99_off else 0.0
+
+    row = {
+        "metric": "qos_interactive_isolation",
+        "unit": "ms",
+        "value": p99_on,  # interactive p99 under hog flood, qos on
+        "p99_ms_qos_off": p99_off,
+        "p99_ms_unloaded": p99_unloaded,
+        "p50_ms": p50_on,
+        "p50_ms_qos_off": p50_off,
+        "improvement_pct": round(improvement, 2),
+        "interactive_reqs_on": len(lats_on),
+        "interactive_reqs_off": len(lats_off),
+        "interactive_errors_on": _errs(gold_on),
+        "interactive_errors_off": _errs(gold_off),
+        "hog_completed_on": hog_on.get(200, 0),
+        "hog_shed_on": hog_on.get(503, 0),
+        "hog_completed_off": hog_off.get(200, 0),
+        "hog_shed_off": hog_off.get(503, 0),
+    }
+    print(json.dumps(row))
+
+    if _errs(gold_on) > _errs(gold_off):
+        # the PROTECTED tenant must never be the one shed: share caps and
+        # class shedding exist to refuse the hog, not the gold client
+        print(f"[qos-bench] FAIL: qos arm added interactive errors "
+              f"({_errs(gold_off)} -> {_errs(gold_on)}: {gold_on})",
+              file=sys.stderr)
+        return 1
+    if p99_off and p99_on > p99_off * (1.0 + tolerance / 100.0):
+        print(f"[qos-bench] FAIL: interactive p99 with qos on "
+              f"({p99_on:.1f} ms) did not improve on qos off "
+              f"({p99_off:.1f} ms, {tolerance:.0f}% slack)", file=sys.stderr)
+        return 1
+    if p99_unloaded and p99_on > iso_factor * p99_unloaded:
+        print(f"[qos-bench] FAIL: interactive p99 under flood "
+              f"({p99_on:.1f} ms) exceeds {iso_factor:.0f}x its unloaded "
+              f"p99 ({p99_unloaded:.1f} ms) — isolation not achieved",
+              file=sys.stderr)
+        return 1
+    print(f"[qos-bench] interactive p99 under hog flood: "
+          f"{p99_off:.1f} ms (fifo) -> {p99_on:.1f} ms (qos), "
+          f"{improvement:.1f}% better; unloaded {p99_unloaded:.1f} ms; "
+          f"hog shed {hog_on.get(503, 0)} of its overflow", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-attempt origin connect timeout in seconds")
     p.add_argument("--source-read-timeout", type=float, default=30.0,
                    help="per-attempt origin total read timeout in seconds")
+    # multi-tenant QoS (imaginary_tpu/qos/): tenant table + priority
+    # classes + per-tenant rates/shares; defaults OFF (single default
+    # tenant, FIFO executor intake, byte-identical responses)
+    p.add_argument("--qos-config",
+                   default=os.environ.get("IMAGINARY_TPU_QOS_CONFIG", ""),
+                   help="multi-tenant QoS policy: inline JSON (starts "
+                        "with '{') or a file path; tenants carry a class "
+                        "(interactive|standard|batch), rate/burst "
+                        "overrides, and a max queue share (see README "
+                        "Multi-tenant QoS); empty disables qos")
     p.add_argument("--workers", type=int, default=1,
                    help="serving processes on one port via SO_REUSEPORT "
                         "(0 = one per CPU core); worker 0 owns the device, "
@@ -251,6 +261,16 @@ def options_from_args(args) -> ServerOptions:
         raise SystemExit(f"mount directory does not exist: {args.mount}")
     if args.http_cache_ttl < -1 or args.http_cache_ttl > 31556926:
         raise SystemExit("The -http-cache-ttl flag only accepts a value from 0 to 31556926")
+    if args.qos_config:
+        # validate at boot, like the placeholder/signature checks above:
+        # a typo'd tenant table must refuse to start, not silently serve
+        # with no isolation (create_app parses it again at assembly)
+        from imaginary_tpu.qos.tenancy import load_policy
+
+        try:
+            load_policy(args.qos_config)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
 
     return ServerOptions(
         port=port,
@@ -291,6 +311,7 @@ def options_from_args(args) -> ServerOptions:
         source_retries=max(0, args.source_retries),
         source_connect_timeout_s=max(0.001, args.source_connect_timeout),
         source_read_timeout_s=max(0.001, args.source_read_timeout),
+        qos_config=args.qos_config,
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,

@@ -140,6 +140,13 @@ class ExecutorConfig:
     # zombie drain's results are discarded if the call ever returns.
     # 0 disables.
     drain_watchdog_s: float = 20.0
+    # Multi-tenant QoS policy (imaginary_tpu/qos/tenancy.py QosPolicy).
+    # When set, the FIFO intake queue is replaced by the class-aware fair
+    # scheduler (qos/sched.py): strict priority with aging between
+    # classes, EDF within a class, per-tenant in-queue share caps. None
+    # (the default) keeps the plain queue.Queue — the parity path is the
+    # seed's, byte for byte.
+    qos: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -251,12 +258,16 @@ def last_placement() -> Optional[str]:
 
 
 class _Item:
-    __slots__ = ("arr", "plan", "future", "key", "t", "wire_mb", "mpix")
+    __slots__ = ("arr", "plan", "future", "key", "t", "wire_mb", "mpix",
+                 "qos")
 
     def __init__(self, arr: np.ndarray, plan: ImagePlan):
         self.arr = arr
         self.plan = plan
         self.future: Future = Future()
+        # (tenant, class_index, max_share, deadline_t) stamped by submit()
+        # when a qos policy is active; None rides the FIFO path untouched
+        self.qos = None
         if plan.in_bucket is not None:  # packed transport: pre-padded array
             hb, wb = plan.in_bucket
             in_h, in_w = plan.in_h, plan.in_w
@@ -289,7 +300,15 @@ class Executor:
         if self.config.host_spill is None:
             self.config = dataclasses.replace(self.config, host_spill=True)
         self.stats = ExecutorStats()
-        self._queue: queue_mod.Queue = queue_mod.Queue()
+        if self.config.qos is not None:
+            # class-aware intake (imaginary_tpu/qos/sched.py): same
+            # put/get/qsize/sentinel surface as queue.Queue, so the
+            # collector below is policy-agnostic
+            from imaginary_tpu.qos.sched import FairScheduler
+
+            self._queue = FairScheduler(self.config.qos)
+        else:
+            self._queue = queue_mod.Queue()
         self._sharding = None
         self._spatial_sharding = None
         self._mesh_batch = 1
@@ -447,7 +466,7 @@ class Executor:
             rate_keys = len(self._rate_by_key)
             host_inflight = self._host_inflight
             host_owed = self._host_owed_mpix
-        return {
+        snap = {
             "queue_depth": self.stats.queue_depth,
             "inflight_groups": inflight_groups,
             "drain_in_flight_age_s": drain_age_s,
@@ -464,6 +483,10 @@ class Executor:
             "host_owed_mpix": round(host_owed, 3),
             "host_gate_free_permits": getattr(self._host_gate, "_value", None),
         }
+        if self.config.qos is not None:
+            # per-class intake depth (the fair scheduler's live view)
+            snap["qos_queued"] = self._queue.depths()
+        return snap
 
     def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
         """Enqueue one image; resolves to the output HWC uint8 array.
@@ -476,6 +499,14 @@ class Executor:
         """
         failpoints.hit("executor.submit")
         item = _Item(arr, plan)
+        if self.config.qos is not None:
+            # tenant/class/deadline stamp for the fair scheduler, read
+            # from the trace contextvar (submit runs on the request's
+            # pool thread, whose context copy_context() carried over) —
+            # stamped before the spill branch so shadow probes inherit it
+            from imaginary_tpu.qos.tenancy import request_qos
+
+            item.qos = request_qos(self.config.qos)
         _PLACEMENT.value = "device"
         if not plan.stages:  # identity chain: no device work at all
             item.future.set_result(arr)
@@ -542,7 +573,17 @@ class Executor:
                 self._host_release(item.mpix)
                 self._host_gate.release()
         self._charge_owed(item)
-        self._queue.put(item)
+        try:
+            self._queue.put(item)
+        except Exception:
+            # qos share cap (TenantShareExceeded, a 503 ImageError):
+            # cancelling the never-enqueued future fires the done-callback
+            # and refunds the owed-ms charge booked two lines up; the
+            # error surfaces to the caller like any submit-path failure.
+            # A plain queue.Queue never raises, so the parity path cannot
+            # take this branch.
+            item.future.cancel()
+            raise
         return item.future
 
     def _host_charge(self, mpix: float) -> None:
@@ -706,10 +747,17 @@ class Executor:
         the host). The input array is shared read-only — launch_batch
         copies it into the batch stack."""
         shadow = _Item(item.arr, item.plan)
-        self.stats.shadow_probes += 1
+        shadow.qos = item.qos
         self._charge_owed(shadow)
         shadow.future.add_done_callback(lambda f: f.exception())  # swallow
-        self._queue.put(shadow)
+        try:
+            self._queue.put(shadow)
+        except Exception:
+            # share-capped tenant: skip the probe (its real request is
+            # serving from the host anyway) and refund the charge
+            shadow.future.cancel()
+            return
+        self.stats.shadow_probes += 1
 
     def process(self, arr: np.ndarray, plan: ImagePlan, timeout: float = 120.0) -> np.ndarray:
         """Blocking convenience wrapper."""

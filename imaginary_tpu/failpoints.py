@@ -12,6 +12,10 @@ Sites (each named for the subsystem boundary it sits on):
 
   source.fetch     one remote ?url=/watermark GET attempt (web/sources.py)
   source.head      the HEAD size pre-check (web/sources.py)
+  qos.admit        the admission gate decision (web/handlers.py): an
+                   injected error SHEDS the request (503 + Retry-After,
+                   the overload contract), so chaos runs can exercise
+                   shed handling without building real backlog
   codec.decode     host image decode (pipeline.py, pool thread)
   executor.submit  micro-batch executor entry (engine/executor.py)
   device.execute   device dispatch inside the collector (engine/executor.py)
@@ -50,6 +54,7 @@ from typing import Optional
 SITES = (
     "source.fetch",
     "source.head",
+    "qos.admit",
     "codec.decode",
     "executor.submit",
     "device.execute",

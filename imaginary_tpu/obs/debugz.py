@@ -97,6 +97,12 @@ def debug_payload(service) -> dict:
             "estimated_queue_ms": round(service.estimated_queue_ms(), 3),
         }
         payload["cache"] = service.caches.to_dict()
+        qos = getattr(service, "qos", None)
+        if qos is not None:
+            # secret-free tenant table + per-class counters + live intake
+            # depths (imaginary_tpu/qos/tenancy.py QosPolicy.snapshot);
+            # api keys appear as COUNTS only
+            payload["qos"] = qos.snapshot()
     return payload
 
 

@@ -24,6 +24,8 @@ Schema (stable field names — tests/test_obs.py pins them):
   cache         off | result_miss | result_hit | etag_304
   coalesced     true when this request waited on another's pipeline run
   placement     device | host (where the pixels were computed)
+  tenant        resolved qos tenant name (only with --qos-config)
+  qos_class     interactive | standard | batch (only with --qos-config)
   spans         [{name, start_ms, dur_ms}] full timeline
 """
 

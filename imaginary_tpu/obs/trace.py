@@ -74,7 +74,7 @@ class RequestTrace:
 
     __slots__ = ("request_id", "trace_id", "parent_span_id", "span_id",
                  "flags", "enabled", "t0", "spans", "fields", "deadline",
-                 "_lock")
+                 "tenant", "_lock")
 
     def __init__(self, request_id: str, traceparent: str = "",
                  enabled: bool = True):
@@ -102,6 +102,13 @@ class RequestTrace:
         # deadline enforcement works even with tracing disabled (enabled
         # gates span accumulation, not identity or lifecycle state).
         self.deadline = None
+        # Resolved TenantSpec (imaginary_tpu/qos/tenancy.py), stamped by
+        # the web middleware when a qos policy is configured. Rides the
+        # trace for the same reason the deadline does: copy_context()
+        # carries ONE vehicle into pool threads, and the executor's fair
+        # scheduler reads tenant+class from it at submit time. None when
+        # qos is off (the default) — every consumer takes a fast path.
+        self.tenant = None
         self._lock = threading.Lock()
 
     # -- accumulation (called from handler tasks AND pool threads) ---------

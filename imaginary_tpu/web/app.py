@@ -49,16 +49,24 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     from imaginary_tpu import failpoints
 
     failpoints.activate_from_env()
+    # Multi-tenant QoS policy (imaginary_tpu/qos/): parsed ONCE here and
+    # handed to everyone who enforces a slice of it — the trace
+    # middleware (tenant resolution), the throttle (per-tenant rates),
+    # and the service/executor (fair scheduling + class shedding). None
+    # when --qos-config is unset: every consumer takes its parity path.
+    from imaginary_tpu.qos.tenancy import load_policy
+
+    qos = load_policy(o.qos_config)
     # trace middleware is OUTERMOST: it assigns request identity and
     # installs the contextvar trace before the access log (which reads
     # the id) and everything inside it runs
     app = web.Application(
-        middlewares=[trace_middleware(o, log_stream),
+        middlewares=[trace_middleware(o, log_stream, qos=qos),
                      access_log_middleware(o.log_level, log_stream)]
-        + build_middlewares(o),
+        + build_middlewares(o, qos=qos),
         client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
     )
-    service = ImageService(o)
+    service = ImageService(o, qos=qos)
     app["service"] = service
     app["options"] = o
 

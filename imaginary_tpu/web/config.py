@@ -70,6 +70,12 @@ class ServerOptions:
     # the request deadline) decides what happens next.
     source_connect_timeout_s: float = 5.0
     source_read_timeout_s: float = 30.0
+    # --- multi-tenant QoS (imaginary_tpu/qos/) -------------------------------
+    # Tenant table + scheduler/shed knobs: inline JSON (starts with '{')
+    # or a file path; parsed once at assembly (qos/tenancy.load_policy).
+    # "" = qos OFF (parity): single default tenant, the executor keeps
+    # its FIFO queue, responses byte-identical to the pre-qos build.
+    qos_config: str = ""
     # --- TPU engine knobs (no reference counterpart) -------------------------
     batch_window_ms: float = 3.0
     # default mirrors engine.executor.MAX_BATCH (kept literal here so this

@@ -25,6 +25,7 @@ from aiohttp import web
 from imaginary_tpu import cache as cache_mod
 from imaginary_tpu import codecs
 from imaginary_tpu import deadline as deadline_mod
+from imaginary_tpu import failpoints
 from imaginary_tpu.engine import Executor, ExecutorConfig
 from imaginary_tpu.errors import (
     ErrEmptyBody,
@@ -78,8 +79,16 @@ class ImageService:
     """Owns the micro-batch executor, the host thread pool (decode/encode
     parallelism), and the source registry."""
 
-    def __init__(self, o: ServerOptions):
+    def __init__(self, o: ServerOptions, qos=None):
         self.options = o
+        # multi-tenant QoS policy (imaginary_tpu/qos/): create_app builds
+        # it once and passes it in; direct constructors (tests, benches)
+        # get it parsed from the options here. None = qos off.
+        if qos is None and o.qos_config:
+            from imaginary_tpu.qos.tenancy import load_policy
+
+            qos = load_policy(o.qos_config)
+        self.qos = qos
         # content-addressed cache tiers (imaginary_tpu/cache.py): result
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
@@ -97,6 +106,7 @@ class ImageService:
                 spatial_threshold_px=o.spatial_threshold_px,
                 host_spill=o.host_spill,
                 force_host=o.force_host,
+                qos=qos,
             )
         )
         from imaginary_tpu.engine.executor import _available_cpus
@@ -134,20 +144,46 @@ class ImageService:
         if tr is not None:
             tr.annotate(op=op_name)
         dl = deadline_mod.current()
+        qos = self.qos
+        kidx = 1  # CLASSES index; "standard" when qos is off
+        if qos is not None:
+            ten = getattr(tr, "tenant", None) if tr is not None else None
+            kidx = (ten or qos.default).class_index
         try:
             if o.enable_url_signature:
                 check_url_signature(request, o)
             validate_image_request(request, o)
+            try:
+                # chaos site: an injected error IS a shed decision — the
+                # same 503 + Retry-After contract as real overload, so
+                # `make chaos` can exercise client-visible shedding
+                # without building actual backlog
+                await failpoints.ahit("qos.admit")
+            except failpoints.FailpointError:
+                if qos is not None:
+                    qos.stats.note_shed(kidx)
+                raise new_error(
+                    "Request shed by admission control, retry later", 503,
+                    headers={"Retry-After": "1"}) from None
             est_ms = None
             if o.max_queue_ms > 0 or dl is not None:
                 est_ms = self.estimated_queue_ms()
-            if o.max_queue_ms > 0 and est_ms > o.max_queue_ms:
+            limit_ms = o.max_queue_ms
+            if qos is not None and o.max_queue_ms > 0:
+                # DAGOR-style class grading: the lowest class sheds at
+                # half the operator's budget, standard at 3/4, so under
+                # building overload capacity is reserved for the classes
+                # whose latency is actually sold (qos/shed.py)
+                limit_ms = qos.shed_threshold_ms(kidx, o.max_queue_ms)
+            if o.max_queue_ms > 0 and est_ms > limit_ms:
                 # depth-based admission control: shed load BEFORE fetching
                 # the source — at overload an operator wants bounded
                 # latency + fast 503s, not an unbounded queue (GCRA bounds
                 # the rate; this bounds what a burst can pile up).
                 # Retry-After mirrors the rate-limiter's 503 contract so
                 # well-behaved clients back off instead of hammering.
+                if qos is not None:
+                    qos.stats.note_shed(kidx)
                 raise new_error(
                     "Server queue is full, retry later", 503,
                     headers={"Retry-After": _retry_after_s(est_ms)})
@@ -161,9 +197,13 @@ class ImageService:
                 if rem <= 0.0:
                     raise dl.error("admission")
                 if est_ms > rem * 1000.0:
+                    if qos is not None:
+                        qos.stats.note_shed(kidx)
                     raise new_error(
                         "Server queue exceeds request deadline, retry later",
                         503, headers={"Retry-After": _retry_after_s(est_ms)})
+            if qos is not None:
+                qos.stats.note_admitted(kidx)
             with obs_trace.span("fetch"):
                 buf = await self._get_source_image(request)
             if not buf:
@@ -468,7 +508,8 @@ async def index_controller(request: web.Request, o: ServerOptions) -> web.Respon
 def collect_health_stats(service: Optional[ImageService]) -> dict:
     """The ONE stats assembly /health and /metrics both serve (they must
     never drift — /metrics promises 'the same numbers as /health')."""
-    stats = get_health_stats(service.executor if service else None)
+    stats = get_health_stats(service.executor if service else None,
+                             qos=service.qos if service else None)
     if service is not None:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads

@@ -27,7 +27,7 @@ def _rss_mb() -> float:
     return 0.0
 
 
-def get_health_stats(executor=None) -> dict:
+def get_health_stats(executor=None, qos=None) -> dict:
     import gc
 
     stats = {
@@ -54,6 +54,11 @@ def get_health_stats(executor=None) -> dict:
         stats["backend"] = "unavailable"
     if executor is not None:
         stats["executor"] = executor.stats.to_dict()
+    if qos is not None:
+        # per-class qos counters + live queue depths (qos/shed.py
+        # QosStats); /metrics renders the same block as
+        # imaginary_tpu_qos_* so the two surfaces cannot drift
+        stats["qos"] = qos.stats.to_dict()
     from imaginary_tpu.engine.timing import TIMES
 
     stage_times = TIMES.snapshot()

@@ -74,6 +74,7 @@ def render_metrics(stats: dict) -> str:
     # stage_ms and stage_total families)
     stage_ms: list = []
     stage_total: list = []
+    qos_classes: dict = {}
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
             for k, v in value.items():
@@ -100,6 +101,11 @@ def render_metrics(stats: dict) -> str:
                             _snake(q).replace("_ms", ""))
                         stage_ms.append(
                             (f'stage="{lab}",q="{qlab}"', v))
+        elif key == "qos" and isinstance(value, dict):
+            # per-class qos block (qos/shed.py QosStats.to_dict):
+            # deferred like the stage families so each imaginary_tpu_qos_*
+            # family's class-labeled samples stay contiguous
+            qos_classes = value.get("classes", {})
         elif key == "backend":
             x.emit("imaginary_tpu_backend_info", 1,
                    f'backend="{escape_label_value(value)}"',
@@ -107,6 +113,24 @@ def render_metrics(stats: dict) -> str:
         else:
             x.emit(f"imaginary_tpu_{_snake(key)}", value,
                    help_text=f"{key} (see /health).")
+    _qos_help = {
+        "queued": "Requests waiting in the executor intake queue per class.",
+        "admitted": "Requests that passed the admission gate per class.",
+        "shed": "Requests shed 503 by overload/admission control per class.",
+        "share_rejected": "Queue puts rejected by a tenant share cap.",
+        "rate_limited": "Requests 429d by the per-tenant GCRA per class.",
+        "dispatched": "Items popped from the qos scheduler per class.",
+    }
+    for metric, help_text in _qos_help.items():
+        for cls, counters in qos_classes.items():
+            if metric not in counters:
+                continue
+            name = "imaginary_tpu_qos_" + (
+                metric if metric == "queued" else metric + "_total")
+            x.emit(name, counters[metric],
+                   f'class="{escape_label_value(cls)}"',
+                   mtype="gauge" if metric == "queued" else "counter",
+                   help_text=help_text)
     for labels, v in stage_total:
         x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
                help_text="Samples recorded per pipeline stage.")

@@ -68,8 +68,17 @@ class GCRARateLimiter:
         self._tat: dict = {}
         self._lock = threading.Lock()
 
-    def allow(self, key: str):
-        """Returns (allowed, retry_after_seconds)."""
+    def allow(self, key: str, emission: float = None, tau: float = None):
+        """Returns (allowed, retry_after_seconds). `emission`/`tau`
+        override the constructor's global parameters for THIS key — the
+        qos layer (imaginary_tpu/qos/limiter.py) rekeys the store by
+        tenant and each tenant carries its own rate/burst contract; the
+        tat state stays in one shared store so the key-flood eviction
+        above governs every keying scheme."""
+        if emission is None:
+            emission = self.emission
+        if tau is None:
+            tau = self.tau
         now = time.monotonic()
         with self._lock:
             if len(self._tat) >= self.MAX_KEYS and key not in self._tat:
@@ -79,9 +88,9 @@ class GCRARateLimiter:
                                   reverse=True)[: self.MAX_KEYS // 2]
                     self._tat = dict(keep)
             tat = max(self._tat.get(key, now), now)
-            if tat - now > self.tau:
-                return False, tat - self.tau - now
-            self._tat[key] = tat + self.emission
+            if tat - now > tau:
+                return False, tat - tau - now
+            self._tat[key] = tat + emission
             return True, 0.0
 
 
@@ -115,7 +124,7 @@ def _route_label(request: web.Request) -> str:
     return canonical or "unmatched"
 
 
-def trace_middleware(o: ServerOptions, events_out=None):
+def trace_middleware(o: ServerOptions, events_out=None, qos=None):
     """Outermost middleware: request identity + trace lifecycle.
 
     Assigns/propagates X-Request-ID and W3C traceparent, installs the
@@ -123,7 +132,13 @@ def trace_middleware(o: ServerOptions, events_out=None):
     (access log included — it runs inside this and reads the id), then on
     the way out: echoes X-Request-ID, emits Server-Timing, observes the
     request-duration histogram + RED counters, feeds the slow-request
-    exemplar ring, and (opt-in) writes the JSON wide event."""
+    exemplar ring, and (opt-in) writes the JSON wide event.
+
+    With a qos policy, tenant identity is resolved HERE, next to the
+    request id it is the multi-tenant sibling of: the TenantSpec rides
+    the trace contextvar so the throttle, the admission gate, and the
+    executor scheduler (via pool-thread copy_context) all read one
+    stamp, and tenant+class land in wide events / the slow ring."""
 
     @web.middleware
     async def mw(request: web.Request, handler):
@@ -135,6 +150,11 @@ def trace_middleware(o: ServerOptions, events_out=None):
             traceparent=request.headers.get("traceparent", ""),
             enabled=o.trace_enabled,
         )
+        if qos is not None:
+            ten = qos.resolve(request)
+            tr.tenant = ten
+            if tr.enabled:
+                tr.annotate(tenant=ten.name, qos_class=ten.klass)
         # Mint the end-to-end deadline next to the request id: the budget
         # is the server default, lowered (never raised) by the client's
         # X-Request-Timeout header. It rides the trace contextvar so every
@@ -213,7 +233,7 @@ def trace_middleware(o: ServerOptions, events_out=None):
     return mw
 
 
-def build_middlewares(o: ServerOptions) -> list:
+def build_middlewares(o: ServerOptions, qos=None) -> list:
     """The chain, outermost first."""
     mws = [_validate_request(o), _default_headers(o)]
     if o.http_cache_ttl >= 0:
@@ -222,8 +242,11 @@ def build_middlewares(o: ServerOptions) -> list:
         mws.append(_authorize(o))
     if o.cors:
         mws.append(_cors(o))
-    if o.concurrency > 0:
-        mws.append(_throttle(o))
+    # the throttle installs for the global --concurrency limit as before,
+    # and ALSO when any qos tenant carries its own rate (a tenant contract
+    # must bind even when the operator set no global ceiling)
+    if o.concurrency > 0 or (qos is not None and qos.any_rate()):
+        mws.append(_throttle(o, qos))
     if o.endpoints:
         mws.append(_endpoints_guard(o))
     return mws
@@ -306,18 +329,40 @@ def _cors(o: ServerOptions):
     return mw
 
 
-def _throttle(o: ServerOptions):
+def _throttle(o: ServerOptions, qos=None):
+    """Rate limiting. Without qos: the reference's method-keyed GCRA on
+    the global --concurrency/--burst. With qos: keyed by TENANT (read
+    from the trace stamp the outer middleware installed), each tenant's
+    rate/burst overriding the global (imaginary_tpu/qos/limiter.py).
+
+    The 429 carries the JSON ImageError body (or the placeholder, when
+    enabled) like every other terminal error — the reference's throttled
+    handler replies through its ErrorReply path too; the old bare
+    text/plain reply was a parity bug (PARITY.md r9)."""
     limiter = GCRARateLimiter(o.concurrency, o.burst)
+    tenant_limiter = None
+    if qos is not None:
+        from imaginary_tpu.qos.limiter import TenantLimiter
+
+        tenant_limiter = TenantLimiter(o.concurrency, o.burst)
 
     @web.middleware
     async def mw(request, handler):
-        allowed, retry = limiter.allow(request.method)
+        if tenant_limiter is None:
+            allowed, retry = limiter.allow(request.method)
+        else:
+            tr = obs_trace.current()
+            ten = getattr(tr, "tenant", None) if tr is not None else None
+            if ten is None:
+                ten = qos.default
+            allowed, retry = tenant_limiter.allow(ten)
+            if not allowed:
+                qos.stats.note_rate_limited(ten.class_index)
         if not allowed:
-            return web.Response(
-                status=429,
-                text="Too Many Requests",
-                headers={"Retry-After": str(max(1, int(retry + 0.5)))},
-            )
+            err = ImageError(
+                "Too Many Requests", 429,
+                headers={"Retry-After": str(max(1, int(retry + 0.5)))})
+            return error_response(request, err, o)
         return await handler(request)
 
     return mw

@@ -1,0 +1,726 @@
+"""Multi-tenant QoS tests (ISSUE 5).
+
+Four layers, mirroring the qos package:
+
+  * tenancy: --qos-config parsing/validation + per-request resolution
+  * limiter: per-tenant GCRA overrides AND the shared store's key-flood
+    eviction branch (the MAX_KEYS sweep/evict path the tentpole rekeys
+    by tenant — previously untested)
+  * sched:   the fair-scheduler invariants — FIFO parity with qos off,
+    strict priority, bounded-aging no-starvation, EDF within a class,
+    per-tenant share caps with the 503 + Retry-After contract
+  * HTTP:    the wired surfaces — 429 JSON/placeholder bodies, RED
+    counting, class-graded shedding, qos.admit failpoint, /health,
+    /metrics (strict exposition), /debugz, wide-event stamping, and
+    qos-off byte parity
+"""
+
+import asyncio
+import io
+import json
+import queue as queue_mod
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+from PIL import Image
+
+from imaginary_tpu import failpoints
+from imaginary_tpu.qos import CLASSES
+from imaginary_tpu.qos.limiter import TenantLimiter
+from imaginary_tpu.qos.sched import FairScheduler
+from imaginary_tpu.qos.shed import TenantShareExceeded
+from imaginary_tpu.qos.tenancy import (
+    TenantSpec,
+    load_policy,
+    parse_policy,
+    request_qos,
+)
+from imaginary_tpu.web.config import ServerOptions
+from imaginary_tpu.web.middleware import GCRARateLimiter
+
+
+def policy(**overrides):
+    """A small two-tenant policy: gold=interactive (keyed), hog=batch
+    (ip-matched, 1/16 queue share on a 64-slot queue -> cap 4)."""
+    doc = {
+        "default": {"class": "standard"},
+        "tenants": [
+            {"name": "gold", "class": "interactive",
+             "api_keys": ["gold-key"]},
+            {"name": "hog", "class": "batch", "ips": ["10.9.9.9"],
+             "max_share": 1.0 / 16.0},
+        ],
+        "queue_cap": 64,
+    }
+    doc.update(overrides)
+    return parse_policy(json.dumps(doc))
+
+
+class Item:
+    """Stand-in for the executor's _Item: the scheduler only reads .qos."""
+
+    def __init__(self, qos=None, tag=None):
+        self.qos = qos
+        self.tag = tag
+
+
+def drain(sched, n):
+    return [sched.get_nowait().tag for _ in range(n)]
+
+
+# --- tenancy ------------------------------------------------------------------
+
+
+class TestPolicyParsing:
+    def test_empty_is_off(self):
+        assert load_policy("") is None
+        assert load_policy("   ") is None
+
+    def test_file_path(self, tmp_path):
+        p = tmp_path / "qos.json"
+        p.write_text(json.dumps({"default": {"class": "batch"}}))
+        pol = load_policy(str(p))
+        assert pol.default.klass == "batch"
+
+    def test_missing_file_fails_loudly(self):
+        with pytest.raises(ValueError, match="cannot read"):
+            load_policy("/nonexistent/qos.json")
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError, match="unknown class"):
+            parse_policy('{"default": {"class": "platinum"}}')
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown top-level"):
+            parse_policy('{"tenantz": []}')
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_policy('{"default": {"clazz": "batch"}}')
+
+    def test_bad_max_share_rejected(self):
+        with pytest.raises(ValueError, match="max_share"):
+            parse_policy('{"default": {"max_share": 0}}')
+        with pytest.raises(ValueError, match="max_share"):
+            parse_policy('{"default": {"max_share": 1.5}}')
+
+    def test_duplicate_tenant_rejected(self):
+        doc = {"tenants": [
+            {"name": "a", "api_keys": ["x"]},
+            {"name": "a", "api_keys": ["y"]},
+        ]}
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_policy(json.dumps(doc))
+
+    def test_unmatchable_tenant_rejected(self):
+        with pytest.raises(ValueError, match="matches nothing"):
+            parse_policy('{"tenants": [{"name": "ghost"}]}')
+
+    def test_default_cannot_carry_keys(self):
+        with pytest.raises(ValueError, match="default tenant cannot"):
+            parse_policy('{"default": {"api_keys": ["k"]}}')
+
+    def test_invalid_json(self):
+        with pytest.raises(ValueError, match="invalid JSON"):
+            parse_policy("{nope")
+
+    def test_snapshot_never_leaks_keys(self):
+        snap = policy().snapshot()
+        assert "gold-key" not in json.dumps(snap)
+        gold = next(t for t in snap["tenants"] if t["name"] == "gold")
+        assert gold["api_keys"] == 1  # a count, not the credential
+
+    def test_request_qos_defaults_outside_request(self):
+        name, kidx, share, deadline_t = request_qos(policy())
+        assert name == "default" and CLASSES[kidx] == "standard"
+        assert share == 1.0 and deadline_t is None
+
+
+# --- limiter (satellite: the GCRA key-flood eviction branch) ------------------
+
+
+class TestGCRAEviction:
+    def test_expired_entry_sweep(self, monkeypatch):
+        """When the store hits MAX_KEYS, expired entries (tat in the
+        past) are dropped FIRST; live entries keep their state."""
+        import time as time_mod
+
+        monkeypatch.setattr(GCRARateLimiter, "MAX_KEYS", 8)
+        lim = GCRARateLimiter(per_sec=1, burst=0)
+        now = time_mod.monotonic()
+        # 7 expired keys + 1 live (throttled: tat far in the future)
+        for i in range(7):
+            lim._tat[f"old{i}"] = now - 10.0
+        lim._tat["live"] = now + 100.0
+        allowed, _ = lim.allow("newcomer")
+        assert allowed
+        assert "newcomer" in lim._tat
+        # the sweep dropped only the expired keys; the throttled client
+        # kept its state and is still throttled
+        assert all(f"old{i}" not in lim._tat for i in range(7))
+        blocked, retry = lim.allow("live")
+        assert not blocked and retry > 0
+
+    def test_oldest_tat_half_eviction_keeps_throttled(self, monkeypatch):
+        """All-live flood: the oldest-tat half evicts; clients closest to
+        throttle (largest tat) keep their state."""
+        import time as time_mod
+
+        monkeypatch.setattr(GCRARateLimiter, "MAX_KEYS", 8)
+        lim = GCRARateLimiter(per_sec=1, burst=0)
+        now = time_mod.monotonic()
+        for i in range(8):
+            lim._tat[f"k{i}"] = now + 10.0 + i  # all live, k7 most throttled
+        lim.allow("flood")
+        # kept: the MAX_KEYS//2 largest tats (k4..k7)
+        assert all(f"k{i}" in lim._tat for i in range(4, 8))
+        assert all(f"k{i}" not in lim._tat for i in range(4))
+        blocked, _ = lim.allow("k7")
+        assert not blocked
+
+    def test_throttle_state_survives_flood(self, monkeypatch):
+        """End-to-end: throttle a client, flood with fresh keys past
+        MAX_KEYS, the throttled client is STILL throttled."""
+        monkeypatch.setattr(GCRARateLimiter, "MAX_KEYS", 16)
+        lim = GCRARateLimiter(per_sec=1, burst=1)
+        for _ in range(5):
+            lim.allow("victim")  # drive tat well past now
+        assert lim.allow("victim")[0] is False
+        for i in range(40):
+            lim.allow(f"flood{i}")
+        assert lim.allow("victim")[0] is False
+
+    def test_per_key_override_params(self):
+        """The qos layer's per-tenant emission/tau ride per call over one
+        shared store: a strict tenant throttles while a generous one
+        flows, in the same limiter."""
+        lim = GCRARateLimiter(per_sec=1000, burst=100)
+        strict = dict(emission=1.0, tau=0.0)  # 1 rps, no burst
+        assert lim.allow("t:strict", **strict)[0] is True
+        assert lim.allow("t:strict", **strict)[0] is False
+        for _ in range(20):
+            assert lim.allow("t:generous")[0] is True  # global params
+
+
+class TestTenantLimiter:
+    def test_tenant_rate_overrides_global(self):
+        tl = TenantLimiter(global_rate=1000, global_burst=100)
+        strict = TenantSpec(name="s", rate=1.0, burst=0)
+        assert tl.allow(strict)[0] is True
+        allowed, retry = tl.allow(strict)
+        assert allowed is False and retry > 0
+
+    def test_inherits_global_when_no_rate(self):
+        tl = TenantLimiter(global_rate=1, global_burst=0)
+        ten = TenantSpec(name="t")
+        assert tl.allow(ten)[0] is True
+        assert tl.allow(ten)[0] is False
+
+    def test_unlimited_mints_no_state(self):
+        tl = TenantLimiter(global_rate=0, global_burst=0)
+        ten = TenantSpec(name="anon")
+        for _ in range(100):
+            assert tl.allow(ten) == (True, 0.0)
+        assert len(tl._gcra._tat) == 0  # no key churn for unlimited tenants
+
+    def test_tenants_do_not_share_buckets(self):
+        tl = TenantLimiter(global_rate=1, global_burst=0)
+        assert tl.allow(TenantSpec(name="a"))[0] is True
+        assert tl.allow(TenantSpec(name="b"))[0] is True  # own key
+        assert tl.allow(TenantSpec(name="a"))[0] is False
+
+
+# --- sched --------------------------------------------------------------------
+
+
+class TestFairScheduler:
+    def test_fifo_parity_default_tenant(self):
+        """qos on with nothing but the default tenant orders EXACTLY like
+        the seed FIFO queue (no deadlines -> (inf, seq) heap keys)."""
+        s = FairScheduler(policy())
+        for i in range(32):
+            s.put(Item(tag=i))
+        assert drain(s, 32) == list(range(32))
+
+    def test_sentinel_never_overtakes_items(self):
+        s = FairScheduler(policy())
+        s.put(Item(tag="a"))
+        s.put(None)  # shutdown sentinel
+        assert s.get_nowait().tag == "a"
+        assert s.get_nowait() is None
+        assert s.get(timeout=0.01) is None  # closed stays closed
+
+    def test_get_timeout_raises_empty(self):
+        s = FairScheduler(policy())
+        with pytest.raises(queue_mod.Empty):
+            s.get(timeout=0.01)
+        with pytest.raises(queue_mod.Empty):
+            s.get_nowait()
+
+    def test_strict_priority_between_classes(self):
+        s = FairScheduler(policy())
+        s.put(Item(qos=("hog", 2, 1.0, None), tag="b"))
+        s.put(Item(qos=("default", 1, 1.0, None), tag="s"))
+        s.put(Item(qos=("gold", 0, 1.0, None), tag="i"))
+        assert drain(s, 3) == ["i", "s", "b"]
+
+    def test_aging_bounds_batch_starvation(self):
+        """Under a sustained interactive flood, a waiting batch item
+        STILL dispatches within aging_dispatches[batch] pops (the
+        no-starvation invariant pure strict priority lacks)."""
+        pol = policy()
+        aging = pol.aging_dispatches[2]
+        s = FairScheduler(pol)
+        s.put(Item(qos=("hog", 2, 1.0, None), tag="batch"))
+        # keep the interactive heap non-empty the whole time
+        for i in range(aging + 4):
+            s.put(Item(qos=("gold", 0, 1.0, None), tag=f"i{i}"))
+        order = []
+        for _ in range(aging + 1):
+            got = s.get_nowait().tag
+            order.append(got)
+            s.put(Item(qos=("gold", 0, 1.0, None), tag="refill"))
+        assert "batch" in order, f"batch starved through {order}"
+        assert order.index("batch") <= aging
+
+    def test_aging_respects_configured_threshold(self):
+        pol = policy(aging_dispatches={"batch": 3})
+        s = FairScheduler(pol)
+        s.put(Item(qos=("hog", 2, 1.0, None), tag="batch"))
+        for i in range(8):
+            s.put(Item(qos=("gold", 0, 1.0, None), tag=f"i{i}"))
+        order = drain(s, 4)
+        assert order == ["i0", "i1", "i2", "batch"]
+
+    def test_edf_within_class(self):
+        """PR-4 deadlines order a class earliest-expiry-first; items
+        without a deadline sort last, in arrival order."""
+        s = FairScheduler(policy())
+        s.put(Item(qos=("d", 1, 1.0, None), tag="none1"))
+        s.put(Item(qos=("d", 1, 1.0, 200.0), tag="late"))
+        s.put(Item(qos=("d", 1, 1.0, 50.0), tag="early"))
+        s.put(Item(qos=("d", 1, 1.0, None), tag="none2"))
+        assert drain(s, 4) == ["early", "late", "none1", "none2"]
+
+    def test_edf_does_not_cross_classes(self):
+        """A desperate batch deadline still yields to interactive (class
+        boundaries are strict; EDF orders only WITHIN a class)."""
+        s = FairScheduler(policy())
+        s.put(Item(qos=("hog", 2, 1.0, 1.0), tag="b-urgent"))
+        s.put(Item(qos=("gold", 0, 1.0, 9999.0), tag="i-relaxed"))
+        assert drain(s, 2) == ["i-relaxed", "b-urgent"]
+
+    def test_tenant_share_cap_rejects_n_plus_1(self):
+        """hog's max_share is 1/16 of a 64-slot queue -> cap 4: the 5th
+        queued item raises the 503 + Retry-After shed contract, and a pop
+        frees a slot."""
+        s = FairScheduler(policy())
+        hog = ("hog", 2, 1.0 / 16.0, None)
+        for i in range(4):
+            s.put(Item(qos=hog, tag=i))
+        with pytest.raises(TenantShareExceeded) as exc:
+            s.put(Item(qos=hog, tag=4))
+        assert exc.value.http_code() == 503
+        assert exc.value.headers.get("Retry-After") == "1"
+        assert "hog" in exc.value.message
+        s.get_nowait()
+        s.put(Item(qos=hog, tag="fits-again"))  # slot freed
+
+    def test_share_cap_does_not_limit_other_tenants(self):
+        s = FairScheduler(policy())
+        for i in range(4):
+            s.put(Item(qos=("hog", 2, 1.0 / 16.0, None)))
+        for i in range(40):  # full-share tenant is uncapped
+            s.put(Item(qos=("gold", 0, 1.0, None)))
+        assert s.qsize() == 44
+
+    def test_depths_and_stats(self):
+        pol = policy()
+        s = FairScheduler(pol)
+        s.put(Item(qos=("gold", 0, 1.0, None)))
+        s.put(Item(qos=("hog", 2, 1.0, None)))
+        assert s.depths() == {"interactive": 1, "standard": 0, "batch": 1}
+        stats = pol.stats.to_dict()["classes"]
+        assert stats["interactive"]["queued"] == 1
+        assert stats["batch"]["queued"] == 1
+        s.get_nowait()
+        assert pol.stats.to_dict()["classes"]["interactive"]["dispatched"] == 1
+
+    def test_blocking_get_wakes_on_put(self):
+        import threading
+
+        s = FairScheduler(policy())
+        got = []
+        t = threading.Thread(target=lambda: got.append(s.get(timeout=5.0)))
+        t.start()
+        s.put(Item(tag="wake"))
+        t.join(timeout=5.0)
+        assert not t.is_alive() and got[0].tag == "wake"
+
+
+class TestExecutorIntegration:
+    def test_fifo_queue_without_qos(self):
+        from imaginary_tpu.engine.executor import Executor
+
+        ex = Executor()
+        try:
+            assert isinstance(ex._queue, queue_mod.Queue)
+            assert "qos_queued" not in ex.debug_snapshot()
+        finally:
+            ex.shutdown()
+
+    def test_fair_scheduler_with_qos(self):
+        from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+
+        ex = Executor(ExecutorConfig(qos=policy()))
+        try:
+            assert isinstance(ex._queue, FairScheduler)
+            snap = ex.debug_snapshot()
+            assert snap["qos_queued"] == {c: 0 for c in CLASSES}
+        finally:
+            ex.shutdown()
+
+    def test_share_cap_refunds_owed_ledger(self):
+        """A submit rejected by the share cap must cancel the future and
+        release its owed-ms charge (the charge/refund pair around the
+        scheduler put in Executor.submit): the overload estimate must not
+        count work that was never queued."""
+        import numpy as np
+
+        from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+        from imaginary_tpu.options import ImageOptions
+        from imaginary_tpu.ops.plan import plan_operation
+
+        ex = Executor(ExecutorConfig(qos=policy(), host_spill=False))
+        try:
+            ex._device_ms_per_mb = 5.0  # price the link so the charge is real
+
+            def reject(_item):
+                raise TenantShareExceeded("hog")
+
+            ex._queue.put = reject  # instance override; deleted below
+            arr = np.zeros((64, 64, 3), dtype=np.uint8)
+            plan = plan_operation("resize", ImageOptions(width=32),
+                                  64, 64, 0, 3)
+            with pytest.raises(TenantShareExceeded):
+                ex.submit(arr, plan)
+            assert ex.estimated_wait_ms() == 0.0
+        finally:
+            del ex._queue.put  # restore for the shutdown sentinel
+            ex.shutdown()
+
+
+# --- HTTP surfaces ------------------------------------------------------------
+
+
+def small_jpeg():
+    im = Image.new("RGB", (64, 48), (120, 30, 200))
+    b = io.BytesIO()
+    im.save(b, "JPEG", quality=90)
+    return b.getvalue()
+
+
+def multipart():
+    from aiohttp import FormData
+
+    form = FormData()
+    form.add_field("file", small_jpeg(), filename="t.jpg",
+                   content_type="image/jpeg")
+    return form
+
+
+def run(options, fn):
+    """Run `fn(client, app)` against a fresh in-process app."""
+
+    async def runner():
+        from imaginary_tpu.web.app import create_app
+
+        app = create_app(options, log_stream=io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, app)
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+QOS_CFG = json.dumps({
+    "default": {"class": "standard"},
+    "tenants": [
+        {"name": "gold", "class": "interactive", "api_keys": ["gold-key"]},
+        {"name": "bulk", "class": "batch", "api_keys": ["bulk-key"]},
+        {"name": "lim", "class": "standard", "api_keys": ["lim-key"],
+         "rate": 1, "burst": 0},
+    ],
+})
+
+
+class TestThrottle429:
+    """Satellite: the 429 carries the JSON ImageError body (placeholder
+    honored) and lands in the RED counters like every terminal status."""
+
+    def test_429_json_body_without_qos(self):
+        async def fn(client, app):
+            # burst=1: 3rd immediate request exceeds tau
+            statuses = []
+            for _ in range(4):
+                r = await client.get("/health")
+                statuses.append(r.status)
+                last = r
+            assert 429 in statuses
+            assert last.status == 429
+            assert last.headers["Retry-After"].isdigit()
+            body = await last.json()
+            assert body == {"message": "Too Many Requests", "status": 429}
+            assert last.content_type == "application/json"
+
+        run(ServerOptions(concurrency=1, burst=1), fn)
+
+    def test_429_placeholder_body(self):
+        async def fn(client, app):
+            last = None
+            for _ in range(4):
+                last = await client.get("/resize?width=50&height=40")
+            assert last.status == 429
+            assert last.content_type.startswith("image/")
+            err = json.loads(last.headers["Error"])
+            assert err["status"] == 429
+            im = Image.open(io.BytesIO(await last.read()))
+            assert (im.width, im.height) == (50, 40)
+
+        run(ServerOptions(concurrency=1, burst=1, enable_placeholder=True,
+                          mount="/tmp"), fn)
+
+    def test_429_counted_in_red_counters(self):
+        async def fn(client, app):
+            # per-tenant limit: lim is 1 rps/no burst; default unlimited
+            assert (await client.get(
+                "/health", headers={"API-Key": "lim-key"})).status == 200
+            r = await client.get("/health", headers={"API-Key": "lim-key"})
+            assert r.status == 429
+            text = await (await client.get("/metrics")).text()
+            from tests.test_obs import parse_exposition_strict
+
+            _, samples = parse_exposition_strict(text)
+            red = {(dict(labels).get("route"), dict(labels).get("code")): v
+                   for n, labels, v in samples
+                   if n == "imaginary_tpu_requests_total"}
+            assert red.get(("/health", "4xx"), 0) >= 1
+
+        run(ServerOptions(qos_config=QOS_CFG), fn)
+
+
+class TestTenantHTTP:
+    def test_per_tenant_limit_leaves_others_alone(self):
+        async def fn(client, app):
+            assert (await client.get(
+                "/health", headers={"API-Key": "lim-key"})).status == 200
+            assert (await client.get(
+                "/health", headers={"API-Key": "lim-key"})).status == 429
+            # gold and anonymous traffic are unlimited (global rate 0)
+            for _ in range(5):
+                assert (await client.get(
+                    "/health", headers={"API-Key": "gold-key"})).status == 200
+                assert (await client.get("/health")).status == 200
+
+        run(ServerOptions(qos_config=QOS_CFG), fn)
+
+    def test_rate_limited_counter_by_class(self):
+        async def fn(client, app):
+            await client.get("/health", headers={"API-Key": "lim-key"})
+            await client.get("/health", headers={"API-Key": "lim-key"})
+            stats = app["service"].qos.stats.to_dict()["classes"]
+            assert stats["standard"]["rate_limited"] >= 1
+
+        run(ServerOptions(qos_config=QOS_CFG), fn)
+
+    def test_tenant_stamped_on_trace_surfaces(self):
+        async def fn(client, app):
+            from imaginary_tpu.obs.debugz import SLOW
+
+            SLOW.clear()  # the ring is process-global; drop other tests' events
+            r = await client.post("/resize?width=32", data=multipart(),
+                                  headers={"API-Key": "gold-key"})
+            assert r.status == 200
+            rid = r.headers["X-Request-ID"]
+            d = await (await client.get("/debugz")).json()
+            ev = next(e for e in d["slowest_requests"]
+                      if e["request_id"] == rid)
+            assert ev["tenant"] == "gold"
+            assert ev["qos_class"] == "interactive"
+            assert d["qos"]["queue_cap"] == 256
+            assert d["executor"]["qos_queued"] == {c: 0 for c in CLASSES}
+
+        run(ServerOptions(qos_config=QOS_CFG, enable_debug=True), fn)
+
+    def test_wide_event_carries_tenant(self):
+        stream = io.StringIO()
+
+        async def fn(client, app):
+            r = await client.post("/resize?width=32", data=multipart(),
+                                  headers={"API-Key": "bulk-key"})
+            assert r.status == 200
+
+        async def runner():
+            from imaginary_tpu.web.app import create_app
+
+            app = create_app(
+                ServerOptions(qos_config=QOS_CFG, wide_events=True),
+                log_stream=stream)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                await fn(client, app)
+            finally:
+                await client.close()
+
+        asyncio.run(runner())
+        events = [json.loads(line) for line in stream.getvalue().splitlines()
+                  if line.startswith("{")]
+        ev = next(e for e in events if e.get("op") == "resize")
+        assert ev["tenant"] == "bulk" and ev["qos_class"] == "batch"
+
+
+class TestClassShedding:
+    def test_lowest_class_sheds_first(self):
+        """With estimated queue delay between the batch and interactive
+        thresholds, batch is refused 503 while interactive still serves
+        (DAGOR shed order)."""
+
+        async def fn(client, app):
+            svc = app["service"]
+            svc.estimated_queue_ms = lambda: 60.0  # 50 < 60 < 75 < 100
+            r = await client.post("/resize?width=32", data=multipart(),
+                                  headers={"API-Key": "bulk-key"})
+            assert r.status == 503
+            assert r.headers["Retry-After"].isdigit()
+            assert (await r.json())["status"] == 503
+            r = await client.post("/resize?width=32", data=multipart(),
+                                  headers={"API-Key": "gold-key"})
+            assert r.status == 200
+            stats = svc.qos.stats.to_dict()["classes"]
+            assert stats["batch"]["shed"] == 1
+            assert stats["interactive"]["admitted"] == 1
+
+        run(ServerOptions(qos_config=QOS_CFG, max_queue_ms=100.0), fn)
+
+    def test_standard_sheds_between(self):
+        async def fn(client, app):
+            app["service"].estimated_queue_ms = lambda: 80.0  # > 75
+            r = await client.post("/resize?width=32", data=multipart())
+            assert r.status == 503
+
+        run(ServerOptions(qos_config=QOS_CFG, max_queue_ms=100.0), fn)
+
+    def test_without_qos_single_threshold(self):
+        async def fn(client, app):
+            app["service"].estimated_queue_ms = lambda: 60.0
+            r = await client.post("/resize?width=32", data=multipart())
+            assert r.status == 200  # 60 < 100: no class grading, no shed
+
+        run(ServerOptions(max_queue_ms=100.0), fn)
+
+
+class TestAdmitFailpoint:
+    def test_injected_shed_decision(self):
+        async def fn(client, app):
+            failpoints.activate("qos.admit=error")
+            try:
+                r = await client.post("/resize?width=32", data=multipart(),
+                                      headers={"API-Key": "bulk-key"})
+                assert r.status == 503
+                assert r.headers["Retry-After"] == "1"
+                body = await r.json()
+                assert "shed" in body["message"]
+            finally:
+                failpoints.deactivate()
+            # disarmed: same request serves
+            r = await client.post("/resize?width=32", data=multipart())
+            assert r.status == 200
+            stats = app["service"].qos.stats.to_dict()["classes"]
+            assert stats["batch"]["shed"] == 1
+
+        run(ServerOptions(qos_config=QOS_CFG), fn)
+
+    def test_once_wrapper_sheds_exactly_one(self):
+        async def fn(client, app):
+            failpoints.activate("qos.admit=once(error)")
+            try:
+                first = await client.post("/resize?width=32",
+                                          data=multipart())
+                second = await client.post("/resize?width=32",
+                                           data=multipart())
+                assert first.status == 503 and second.status == 200
+            finally:
+                failpoints.deactivate()
+
+        run(ServerOptions(), fn)  # the site fires with qos off too
+
+
+class TestQosSurfaces:
+    def test_health_and_metrics_blocks(self):
+        async def fn(client, app):
+            r = await client.post("/resize?width=32", data=multipart(),
+                                  headers={"API-Key": "gold-key"})
+            assert r.status == 200
+            h = await (await client.get("/health")).json()
+            assert set(h["qos"]["classes"]) == set(CLASSES)
+            assert h["qos"]["classes"]["interactive"]["admitted"] >= 1
+            text = await (await client.get("/metrics")).text()
+            from tests.test_obs import parse_exposition_strict
+
+            types, samples = parse_exposition_strict(text)
+            assert types["imaginary_tpu_qos_queued"] == "gauge"
+            assert types["imaginary_tpu_qos_shed_total"] == "counter"
+            qos_names = {n for n, _, _ in samples if "qos" in n}
+            assert {"imaginary_tpu_qos_queued",
+                    "imaginary_tpu_qos_admitted_total",
+                    "imaginary_tpu_qos_shed_total",
+                    "imaginary_tpu_qos_share_rejected_total",
+                    "imaginary_tpu_qos_rate_limited_total",
+                    "imaginary_tpu_qos_dispatched_total"} <= qos_names
+            admitted = [v for n, labels, v in samples
+                        if n == "imaginary_tpu_qos_admitted_total"
+                        and dict(labels)["class"] == "interactive"]
+            assert admitted and admitted[0] >= 1
+
+        run(ServerOptions(qos_config=QOS_CFG), fn)
+
+    def test_qos_off_surfaces_absent(self):
+        async def fn(client, app):
+            h = await (await client.get("/health")).json()
+            assert "qos" not in h
+            text = await (await client.get("/metrics")).text()
+            assert "imaginary_tpu_qos_" not in text
+
+        run(ServerOptions(), fn)
+
+
+class TestQosOffParity:
+    def test_qos_off_and_default_config_byte_identical(self):
+        """The acceptance pin: qos OFF and qos ON with a pure-default
+        config produce byte-identical image responses."""
+        bodies = {}
+
+        def capture(tag, options):
+            async def fn(client, app):
+                r = await client.post("/resize?width=48&height=36",
+                                      data=multipart())
+                assert r.status == 200
+                bodies[tag] = await r.read()
+
+            run(options, fn)
+
+        capture("off", ServerOptions())
+        capture("on", ServerOptions(qos_config='{"default": {}}'))
+        assert bodies["off"] == bodies["on"]
+
+    def test_cli_flag_roundtrip(self):
+        from imaginary_tpu.cli import build_parser, options_from_args
+
+        args = build_parser().parse_args(["--qos-config", '{"default": {}}'])
+        o = options_from_args(args)
+        assert o.qos_config == '{"default": {}}'
+        with pytest.raises(SystemExit):
+            options_from_args(build_parser().parse_args(
+                ["--qos-config", '{"default": {"class": "bogus"}}']))
