@@ -23,14 +23,19 @@ gate: lint test chaos
 	  { echo "bench_qos.py failed - snapshot NOT green"; exit 1; }
 	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline/qos benches all pass"
 
-# Chaos drill (ISSUE 4): the deadline + failpoint suites, then a short
-# firehose soak with a flaky origin injected (source.fetch=error(0.2))
-# asserting availability >= 95%, honest 502/503/504 mapping, deadline
-# boundedness, and ledgers at rest. The failure modes the breaker/gate/
-# retry machinery exists for, exercised on every gate run.
+# Chaos drill (ISSUE 4 + ISSUE 6): the deadline/failpoint/devhealth
+# suites, then two soaks — a flaky-origin row (source.fetch=error(0.2):
+# availability >= 95%, honest 502/503/504 mapping, deadline boundedness,
+# ledgers at rest) and a chip-loss row (device.chip_error on the primary
+# device mid-run: failover keeps serving, the sick chip quarantines
+# alone, the probe re-admits it after its cooldown). The two forced CPU
+# devices make the multi-chip fault-domain path run on hardware-less CI;
+# real multi-chip hosts exercise it natively.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py -q
-	BENCH_DURATION=4 BENCH_CONCURRENCY=8 python bench_chaos.py || \
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py -q
+	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+	  JAX_PLATFORMS=cpu python bench_chaos.py || \
 	  { echo "chaos soak failed - resilience invariants violated"; exit 1; }
 
 # correctness-class lint (ruff.toml). FAILS the gate when ruff finds an

@@ -1,12 +1,12 @@
 #!/usr/bin/env python
-"""Chaos soak: concurrent serving traffic with a flaky origin injected
-through the failpoint harness (`make chaos`).
+"""Chaos soaks: concurrent serving traffic with injected faults
+(`make chaos`). Two rows:
 
-Arms IMAGINARY_TPU_FAILPOINTS="source.fetch=error(0.2)" through the same
-env path a production chaos drill would use (create_app reads it), then
-drives the cache-off zipf hot-URL row with deadlines ON. Invariants the
-soak enforces — the "only resilience you have is the resilience you
-exercise" check, run continuously, not once:
+ROW 1 — flaky origin: arms
+IMAGINARY_TPU_FAILPOINTS="source.fetch=error(0.2)" through the same env
+path a production chaos drill would use (create_app reads it), then
+drives the cache-off zipf hot-URL row with deadlines ON. Invariants —
+the "only resilience you have is the resilience you exercise" check:
 
   * availability: with a 0.2 per-attempt fault rate and the default
     2-retry budget, per-request failure odds are 0.2^3 = 0.8% — the soak
@@ -17,8 +17,19 @@ exercise" check, run continuously, not once:
   * rest state: the coalescer group map and the host-pool inflight
     ledger drain to zero after traffic stops.
 
-Prints one JSON line on stdout; human detail on stderr; nonzero exit on
-any violated invariant.
+ROW 2 — chip loss (ISSUE 6): mid-run, `device.chip_error[0]=error`
+kills the primary device's fault domain. With >= 2 devices (the Makefile
+runs this under XLA_FLAGS=--xla_force_host_platform_device_count=2; real
+multi-chip hosts need no flag) dispatch fails over to the surviving
+chip, the sick one quarantines ALONE, and after the fault clears the
+background probe re-admits it within its cooldown. On a 1-device host
+the row degrades to the PR 4 breaker -> host failover story and still
+holds availability. Invariants: >= 95% 2xx, zero 5xx storm (500s == 0,
+errors only from the breaker's pre-trip window), /health shows the
+quarantine, and the device is HEALTHY again after re-admission.
+
+Prints one JSON line per row on stdout; human detail on stderr; nonzero
+exit on any violated invariant.
 """
 
 from __future__ import annotations
@@ -90,6 +101,243 @@ async def _soak(duration: float, concurrency: int) -> dict:
             "groups_after": groups}
 
 
+async def _chip_loss_soak(duration: float, concurrency: int) -> dict:
+    """Three phases against one server: warm (all domains healthy),
+    fault (chip_error armed on the primary device), recovery (fault
+    cleared; the probe must re-admit)."""
+    from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    # host_spill OFF pins traffic to the device path: on the CPU-fallback
+    # backend the cost model would otherwise spill everything to host and
+    # the chip fault would never be exercised (the breaker's host
+    # FAILOVER is independent of the spill policy and still works)
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, request_timeout_s=10.0, host_spill=False))
+    ex = app["service"].executor
+    counts: dict = {}
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def drive(seconds: float) -> None:
+                deadline = time.monotonic() + seconds
+
+                async def worker():
+                    while time.monotonic() < deadline:
+                        try:
+                            async with session.get(next(urls)) as res:
+                                await res.read()
+                                counts[res.status] = counts.get(res.status, 0) + 1
+                        except Exception:
+                            counts["exc"] = counts.get("exc", 0) + 1
+
+                await asyncio.gather(*[worker() for _ in range(concurrency)])
+
+            # phase 1: warm — resolves the device set, prices the link
+            await drive(max(duration / 4, 1.0))
+            multi = len(ex.devhealth) > 1
+            # a bench-sized cooldown so recovery happens inside the run
+            ex.devhealth.cooldown_s = 1.5
+            spec = "device.chip_error[0]=error" if multi else "device.chip_error=error"
+            print(f"[chaos] chip-loss: arming {spec!r} "
+                  f"({len(ex.devhealth)} device(s))", file=sys.stderr)
+            failpoints.activate(spec)
+            await drive(max(duration / 2, 2.0))
+            mid = ex.devhealth.snapshot()
+            failpoints.deactivate()
+            # phase 3: fault cleared — probe (multi) or half-open request
+            # (single) must re-admit the device
+            await drive(max(duration / 4, 1.0))
+            end_t = time.monotonic() + 10.0
+            readmitted = False
+            while time.monotonic() < end_t:
+                snap = ex.devhealth.snapshot()
+                if snap["quarantined"] == 0 and snap["healthy"] == snap["count"]:
+                    readmitted = True
+                    break
+                await asyncio.sleep(0.1)
+                await drive(0.2)  # single-device half-open needs traffic
+            final = ex.devhealth.snapshot()
+    finally:
+        failpoints.deactivate()
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"counts": counts, "multi_device": multi,
+            "quarantined_mid_fault": mid["quarantined"],
+            "healthy_mid_fault": mid["healthy"],
+            "readmitted": readmitted,
+            "final_devices": final,
+            "breaker_opens": ex.stats.breaker_opens,
+            "breaker_host_served": ex.stats.breaker_host_served}
+
+
+def _chip_loss_row(duration: float, concurrency: int) -> int:
+    got = asyncio.run(_chip_loss_soak(duration, concurrency))
+    counts = got["counts"]
+    total = sum(counts.values())
+    ok = counts.get(200, 0)
+    server_errors = sum(v for k, v in counts.items()
+                        if isinstance(k, int) and 500 <= k < 600 and k not in (502, 503, 504))
+    allowed = sum(counts.get(s, 0) for s in (400, 502, 503, 504))
+    surprises = total - ok - allowed - server_errors
+    row = {
+        "metric": "chaos_chip_loss",
+        "requests": total,
+        "ok": ok,
+        "ok_ratio": round(ok / total, 4) if total else 0.0,
+        "multi_device": got["multi_device"],
+        "quarantined_mid_fault": got["quarantined_mid_fault"],
+        "healthy_mid_fault": got["healthy_mid_fault"],
+        "readmitted": got["readmitted"],
+        "breaker_opens": got["breaker_opens"],
+        "breaker_host_served": got["breaker_host_served"],
+        "counts": {str(k): v for k, v in sorted(counts.items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if total == 0:
+        fails.append("chip-loss soak produced zero requests")
+    if total and ok / total < 0.95:
+        fails.append(f"availability {ok}/{total} below 95% under chip loss")
+    if server_errors:
+        fails.append(f"{server_errors} raw 5xx responses (5xx storm)")
+    if surprises:
+        fails.append(f"{surprises} responses outside 200/400/502/503/504")
+    if got["multi_device"]:
+        if got["quarantined_mid_fault"] != 1:
+            fails.append("sick chip did not quarantine alone "
+                         f"(quarantined={got['quarantined_mid_fault']})")
+        if got["healthy_mid_fault"] < 1:
+            fails.append("no healthy device kept serving during the fault")
+    if not got["readmitted"]:
+        fails.append("device not re-admitted after the fault cleared")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1
+    mode = "failover to peer chip" if got["multi_device"] else "breaker->host"
+    print(f"[chaos] PASS (chip loss, {mode}): {ok}/{total} ok, "
+          f"quarantined_mid_fault={got['quarantined_mid_fault']}, "
+          "re-admitted after cooldown", file=sys.stderr)
+    return 0
+
+
+_HEDGE_ROW_BUDGET = 1.0
+
+
+async def _hedge_arm(duration: float, concurrency: int, hedge_on: bool) -> dict:
+    """One closed-loop arm against a server whose device path carries an
+    injected 250 ms delay (device.execute=delay) — the slow-chip/slow-link
+    shape hedging exists for."""
+    from bench_cache import N_URLS, _start_origin, _start_server
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, host_spill=False,
+        hedge_threshold_ms=60.0 if hedge_on else 0.0,
+        # a demonstration-sized budget: EVERY stuck item may hedge, so
+        # the p99 (not just the p50) shows the effect — the default 5%
+        # protects production overload, but in a short closed-loop row it
+        # would cap at one concurrent twin and leave the tail device-bound
+        hedge_budget=_HEDGE_ROW_BUDGET))
+    ex = app["service"].executor
+    lats: list = []
+    counts: dict = {}
+    try:
+        failpoints.activate("device.execute=delay(250ms)")
+        url = f"{base}/resize?width=300&height=200&url={origin_base}/img/0"
+        conn = aiohttp.TCPConnector(limit=0)
+        deadline = time.monotonic() + duration
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def worker():
+                while time.monotonic() < deadline:
+                    t0 = time.monotonic()
+                    try:
+                        async with session.get(url) as res:
+                            await res.read()
+                            counts[res.status] = counts.get(res.status, 0) + 1
+                    except Exception:
+                        counts["exc"] = counts.get("exc", 0) + 1
+                    lats.append((time.monotonic() - t0) * 1000.0)
+
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+    finally:
+        failpoints.deactivate()
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"lats": lats, "counts": counts,
+            "device_items": ex.stats.items,
+            "hedges_won": ex.stats.hedges_won,
+            "hedges_launched": ex.stats.hedges_launched}
+
+
+def _hedge_row(duration: float, concurrency: int) -> int:
+    from bench_util import pctl
+
+    per_arm = max(duration / 2, 2.0)
+    off = asyncio.run(_hedge_arm(per_arm, concurrency, hedge_on=False))
+    on = asyncio.run(_hedge_arm(per_arm, concurrency, hedge_on=True))
+    n_off, n_on = len(off["lats"]), len(on["lats"])
+    p99_off = pctl(off["lats"], 0.99)
+    p99_on = pctl(on["lats"], 0.99)
+    # device dispatches PER REQUEST: hedge twins run on the HOST, so the
+    # device-side work per request must not grow past the budget
+    dpr_off = off["device_items"] / max(1, n_off)
+    dpr_on = on["device_items"] / max(1, n_on)
+    row = {
+        "metric": "chaos_hedge_slow_device",
+        "unit": "ms",
+        "p99_ms_hedge_off": p99_off,
+        "p99_ms_hedge_on": p99_on,
+        "p50_ms_hedge_off": pctl(off["lats"], 0.50),
+        "p50_ms_hedge_on": pctl(on["lats"], 0.50),
+        "requests_off": n_off,
+        "requests_on": n_on,
+        "device_items_per_request_off": round(dpr_off, 3),
+        "device_items_per_request_on": round(dpr_on, 3),
+        "hedges_launched": on["hedges_launched"],
+        "hedges_won": on["hedges_won"],
+    }
+    print(json.dumps(row))
+    fails = []
+    if n_off == 0 or n_on == 0:
+        fails.append("hedge row produced zero requests in an arm")
+    if on["hedges_won"] == 0:
+        fails.append("no hedge twin ever won against a 250ms-delayed device")
+    if p99_on >= p99_off:
+        fails.append(f"hedging did not improve slow-device p99 "
+                     f"({p99_off:.0f} -> {p99_on:.0f} ms)")
+    if dpr_on > dpr_off * (1.0 + _HEDGE_ROW_BUDGET) + 0.1:
+        fails.append(f"device dispatches per request grew past the hedge "
+                     f"budget ({dpr_off:.2f} -> {dpr_on:.2f})")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[chaos] PASS (hedge): slow-device p99 {p99_off:.0f} -> "
+          f"{p99_on:.0f} ms, {on['hedges_won']} twins won, device work "
+          f"per request {dpr_off:.2f} -> {dpr_on:.2f}", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -145,7 +393,15 @@ def main() -> int:
         return 1
     print(f"[chaos] PASS: {ok}/{total} ok, {allowed_errors} mapped errors, "
           f"worst {got['worst_ms']:.0f}ms, ledgers at rest", file=sys.stderr)
-    return 0
+
+    # ROW 2: chip loss. The env-armed source failpoints must not leak
+    # into this server (create_app re-arms from the env var).
+    os.environ.pop(failpoints.ENV_VAR, None)
+    rc = _chip_loss_row(duration, concurrency)
+    if rc:
+        return rc
+    # ROW 3: hedged failover vs a 250 ms-delayed device, A-B
+    return _hedge_row(duration, concurrency)
 
 
 if __name__ == "__main__":

@@ -181,6 +181,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pin every host-executable plan to the host SIMD "
                         "interpreter (measurement override; device-only "
                         "plans still ride the chip)")
+    # hedged failover dispatch (engine/executor.py): default OFF so the
+    # device path stays byte-identical to the unhedged build
+    p.add_argument("--hedge-threshold-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_HEDGE_THRESHOLD_MS", 0.0),
+                   help="launch a speculative host-path twin when a "
+                        "device request has waited this long (floored at "
+                        "50 ms and at 4x the item's estimated device "
+                        "service time); first success wins, the loser is "
+                        "cancelled; 0 disables hedging")
+    p.add_argument("--hedge-budget", type=float,
+                   default=_env_float("IMAGINARY_TPU_HEDGE_BUDGET", 0.05),
+                   help="max concurrent hedges as a fraction of in-flight "
+                        "device items (floor 1); bounds how much duplicate "
+                        "host work hedging may add under overload")
     p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
     # content-addressed caching (imaginary_tpu/cache.py); every knob also
     # honors an IMAGINARY_TPU_CACHE_* env override and defaults OFF so the
@@ -320,6 +334,8 @@ def options_from_args(args) -> ServerOptions:
         spatial_threshold_px=max(1, args.spatial_threshold_px),
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         force_host=args.force_host,
+        hedge_threshold_ms=max(0.0, args.hedge_threshold_ms),
+        hedge_budget=min(1.0, max(0.0, args.hedge_budget)),
         prewarm=args.prewarm,
         cache_result_mb=max(0.0, args.cache_result_mb),
         cache_frame_mb=max(0.0, args.cache_frame_mb),
@@ -352,8 +368,14 @@ def main(argv=None) -> int:
     from imaginary_tpu.web.workers import WORKER_ENV, run_supervisor, worker_index
 
     if o.workers > 1 and WORKER_ENV not in os.environ:
+        # liveness probe target: /health is a PUBLIC_PATHS route, so no
+        # key rides along; a TLS-only fleet is probed with verification
+        # off (the supervisor talks to its own children over loopback)
+        scheme = "https" if o.cert_file and o.key_file else "http"
+        health_url = (f"{scheme}://127.0.0.1:{o.port}"
+                      f"{o.path_prefix.rstrip('/')}/health")
         return run_supervisor(list(argv) if argv is not None else sys.argv[1:],
-                              o.workers)
+                              o.workers, health_url=health_url)
     if worker_index() > 0:
         # non-owner workers are CPU-pinned BY DESIGN (the chip accepts one
         # client); --require-device is worker 0's guarantee — enforcing it

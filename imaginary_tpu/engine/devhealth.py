@@ -1,0 +1,312 @@
+"""Per-device fault domains: health records, breakers, quarantine, probes.
+
+PR 4 gave the executor ONE circuit breaker for "the device": three
+consecutive failed dispatches/drains flip the whole accelerator to host
+serving. On a multi-chip mesh that is the wrong failure unit — a single
+sick chip (flaky ICI lane, preempted core, bad HBM page) takes N-1
+healthy chips out of service with it. This module turns the breaker into
+N independent fault domains:
+
+  * each device carries its own record (consecutive-failure count,
+    total failures, error-rate + latency EWMAs, last probe time);
+  * a device that trips its per-device threshold is QUARANTINED —
+    removed from the dispatchable set, its traffic re-routed to healthy
+    devices (engine/executor.py round-robins chunks over
+    `healthy`/`half_open` records) or to the host interpreter;
+  * after the cooldown a quarantined device goes HALF-OPEN: with >= 2
+    devices a background probe (a tiny device computation, run with a
+    join timeout so a hung runtime can't wedge the prober) re-admits it
+    on success; with 1 device the next REQUEST is the probe — exactly
+    the PR 4 half-open semantics, so single-chip behavior is the
+    degenerate case of this registry, not a parallel code path.
+
+The old global breaker maps onto the registry as "no device available":
+`Executor._breaker_is_open()` is now `not registry.any_available()`,
+which for one device reduces to `now < quarantined_until` — the PR 4
+expression verbatim. The registry keeps its own lock (never held while
+calling into JAX) and every method is safe from collector, fetcher,
+probe, and request threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+STATE_HEALTHY = "healthy"
+STATE_QUARANTINED = "quarantined"
+STATE_HALF_OPEN = "half_open"
+
+
+class DeviceRecord:
+    """One fault domain's live health state. Mutated only under the
+    registry lock; read-copied into snapshots."""
+
+    __slots__ = (
+        "idx", "consecutive_failures", "failures", "successes",
+        "breaker_opens", "quarantined_until", "error_ewma",
+        "latency_ewma_ms", "last_probe_t", "probes", "readmissions",
+        "last_error",
+    )
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.consecutive_failures = 0
+        self.failures = 0
+        self.successes = 0
+        self.breaker_opens = 0
+        self.quarantined_until = 0.0  # monotonic; 0 = never tripped
+        # Slow-moving rates for operators (the breaker itself acts on the
+        # consecutive count — an EWMA would both trip late on a hard-down
+        # chip and flap on a merely-noisy one).
+        self.error_ewma = 0.0
+        self.latency_ewma_ms = 0.0
+        self.last_probe_t = 0.0
+        self.probes = 0
+        self.readmissions = 0
+        self.last_error = ""
+
+    def state(self, now: float) -> str:
+        if now < self.quarantined_until:
+            return STATE_QUARANTINED
+        if self.quarantined_until > 0.0:
+            # cooldown expired but no success has closed the breaker yet:
+            # the next attempt (request on 1 device, probe on many) decides
+            return STATE_HALF_OPEN
+        return STATE_HEALTHY
+
+    def to_dict(self, now: float) -> dict:
+        return {
+            "device": self.idx,
+            "state": self.state(now),
+            "consecutive_failures": self.consecutive_failures,
+            "failures": self.failures,
+            "successes": self.successes,
+            "breaker_opens": self.breaker_opens,
+            "quarantined_for_s": round(max(0.0, self.quarantined_until - now), 3),
+            "error_ewma": round(self.error_ewma, 4),
+            "latency_ewma_ms": round(self.latency_ewma_ms, 3),
+            "probes": self.probes,
+            "readmissions": self.readmissions,
+            "last_error": self.last_error,
+        }
+
+
+class DeviceHealthRegistry:
+    """Per-device breakers with the PR 4 global breaker as the 1-device
+    degenerate case.
+
+    Trip rule (identical to PR 4 per device): after `threshold`
+    CONSECUTIVE failures a device quarantines for `cooldown_s`; the
+    count persists through the cooldown so one more failure in the
+    half-open window re-opens instantly, and only a success resets it.
+    """
+
+    def __init__(self, n_devices: int = 1, threshold: int = 3,
+                 cooldown_s: float = 30.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._lock = threading.Lock()
+        self._records = [DeviceRecord(i) for i in range(max(1, n_devices))]
+        # bumped on every quarantine/re-admission transition: cheap "did
+        # the topology change" check for consumers that cache a derived
+        # view (the executor's healthy-mesh sharding)
+        self.generation = 0
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+
+    # -- shape -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def resize(self, n_devices: int) -> None:
+        """Grow to the resolved device count (device enumeration is lazy:
+        touching the backend belongs to the first dispatch, not to
+        Executor.__init__, where a dead accelerator tunnel would hang the
+        whole boot). Existing records — device 0 may already carry
+        breaker state — are preserved."""
+        with self._lock:
+            while len(self._records) < n_devices:
+                self._records.append(DeviceRecord(len(self._records)))
+
+    def record(self, idx: int) -> DeviceRecord:
+        with self._lock:
+            return self._records[idx]
+
+    # -- breaker transitions ----------------------------------------------
+
+    def note_failure(self, idx: int, err: object = None) -> bool:
+        """Book one failed dispatch/drain EVENT against device `idx`;
+        returns whether this failure tripped (or re-tripped) its breaker."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._records[idx]
+            rec.consecutive_failures += 1
+            rec.failures += 1
+            rec.error_ewma = 0.8 * rec.error_ewma + 0.2
+            if err is not None:
+                rec.last_error = str(err)[:200]
+            if (
+                rec.consecutive_failures >= self.threshold
+                and now >= rec.quarantined_until
+            ):
+                rec.quarantined_until = now + self.cooldown_s
+                rec.breaker_opens += 1
+                self.generation += 1
+                return True
+            return False
+
+    def note_ok(self, idx: int, latency_ms: Optional[float] = None) -> None:
+        with self._lock:
+            rec = self._records[idx]
+            was_open = rec.quarantined_until > 0.0
+            rec.consecutive_failures = 0
+            rec.quarantined_until = 0.0
+            rec.successes += 1
+            rec.error_ewma *= 0.8
+            if was_open:
+                rec.readmissions += 1
+                self.generation += 1
+            if latency_ms is not None:
+                rec.latency_ewma_ms = (
+                    latency_ms if rec.latency_ewma_ms == 0.0
+                    else 0.8 * rec.latency_ewma_ms + 0.2 * latency_ms
+                )
+
+    def set_consecutive(self, idx: int, n: int) -> None:
+        """Preload the consecutive count (the drain watchdog's 'a 20 s
+        hang is unambiguous' shortcut: threshold-1 plus one note_failure
+        trips in the one shared transition site)."""
+        with self._lock:
+            self._records[idx].consecutive_failures = n
+
+    # -- views -----------------------------------------------------------
+
+    def is_quarantined(self, idx: int) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            return now < self._records[idx].quarantined_until
+
+    def any_available(self) -> bool:
+        """True when at least one device is dispatchable (healthy OR
+        half-open — a half-open device accepts its probe traffic). For
+        one device this is exactly PR 4's `now >= _breaker_open_until`."""
+        now = time.monotonic()
+        with self._lock:
+            return any(now >= r.quarantined_until for r in self._records)
+
+    def healthy_indices(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            return [r.idx for r in self._records if r.state(now) == STATE_HEALTHY]
+
+    def available_indices(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            return [r.idx for r in self._records if now >= r.quarantined_until]
+
+    def pick(self, exclude=()) -> Optional[int]:
+        """STICKY primary selection: the lowest-index dispatchable device,
+        strictly-healthy preferred — so all traffic rides one chip until
+        that chip quarantines, then fails over to the next. Deliberately
+        not round-robin: per-device placement keys the jit compile cache,
+        so rotating would multiply compiles by the device count for zero
+        capacity gain (virtual CPU devices share cores; real multi-chip
+        THROUGHPUT is mesh sharding's job — this ladder buys
+        availability). Half-open devices serve only when nothing healthy
+        remains (1-device half-open = the PR 4 request-probe). None when
+        every device is hard-quarantined or excluded."""
+        now = time.monotonic()
+        with self._lock:
+            for r in self._records:
+                if r.state(now) == STATE_HEALTHY and r.idx not in exclude:
+                    return r.idx
+            for r in self._records:
+                if now >= r.quarantined_until and r.idx not in exclude:
+                    return r.idx
+            return None
+
+    def due_for_probe(self) -> list:
+        """Half-open devices whose cooldown elapsed and whose last probe
+        is at least a cooldown old — the probe loop's work list."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for r in self._records:
+                if (
+                    r.quarantined_until > 0.0
+                    and now >= r.quarantined_until
+                    and now - r.last_probe_t >= min(1.0, self.cooldown_s)
+                ):
+                    out.append(r.idx)
+        return out
+
+    def snapshot(self) -> dict:
+        """The /health `devices` block (also rendered into /metrics as
+        imaginary_tpu_device_state and surfaced by /debugz)."""
+        now = time.monotonic()
+        with self._lock:
+            per = [r.to_dict(now) for r in self._records]
+        healthy = sum(1 for d in per if d["state"] == STATE_HEALTHY)
+        quarantined = sum(1 for d in per if d["state"] == STATE_QUARANTINED)
+        return {
+            "count": len(per),
+            "healthy": healthy,
+            "quarantined": quarantined,
+            "per_device": per,
+        }
+
+    # -- background probe --------------------------------------------------
+
+    def start_probing(self, probe_fn: Callable[[int], None],
+                      timeout_s: float = 5.0) -> None:
+        """Launch the re-admission prober (multi-device deployments only;
+        with one device the next request IS the probe, PR 4 style).
+
+        `probe_fn(idx)` runs a tiny computation on device idx and raises
+        on failure. It executes on a short-lived side thread joined with
+        `timeout_s`: a probe that HANGS inside the runtime (the failure
+        mode the drain watchdog exists for) books a failure and leaves
+        the zombie thread to die with the process, instead of wedging
+        the prober and silently ending all future re-admission."""
+        if self._probe_thread is not None:
+            return
+
+        def loop():
+            while not self._probe_stop.wait(min(1.0, max(0.05, self.cooldown_s / 4))):
+                for idx in self.due_for_probe():
+                    with self._lock:
+                        self._records[idx].last_probe_t = time.monotonic()
+                        self._records[idx].probes += 1
+                    outcome: dict = {}
+
+                    def attempt(i=idx):
+                        try:
+                            t0 = time.monotonic()
+                            probe_fn(i)
+                            outcome["ms"] = (time.monotonic() - t0) * 1000.0
+                        except Exception as e:  # noqa: BLE001 - probe is a boundary
+                            outcome["err"] = e
+
+                    t = threading.Thread(target=attempt, daemon=True,
+                                         name=f"itpu-probe-{idx}")
+                    t.start()
+                    t.join(timeout=timeout_s)
+                    if t.is_alive() or "err" in outcome:
+                        self.note_failure(
+                            idx, outcome.get("err", "probe hang"))
+                    else:
+                        self.note_ok(idx, latency_ms=outcome.get("ms"))
+
+        self._probe_thread = threading.Thread(
+            target=loop, name="itpu-devprobe", daemon=True)
+        self._probe_thread.start()
+
+    def close(self) -> None:
+        self._probe_stop.set()
+        t = self._probe_thread
+        if t is not None:
+            t.join(timeout=5)

@@ -25,10 +25,16 @@ import numpy as np
 
 from imaginary_tpu import failpoints
 from imaginary_tpu.engine import host_exec
+from imaginary_tpu.engine.devhealth import DeviceHealthRegistry
 from imaginary_tpu.engine.timing import TIMES
+from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
 from imaginary_tpu.ops.plan import ImagePlan
+
+# imaginary_tpu/qos CLASS_INDEX["batch"]: batch-class work is never hedged
+# (kept literal so this module stays import-light; test_devhealth pins it)
+_BATCH_CLASS = 2
 
 
 # Single source of truth for the micro-batch chunk cap: the CLI default, the
@@ -117,16 +123,37 @@ class ExecutorConfig:
     # the whole cost as "drain"; flip on for diagnostics when the H2D+compute
     # vs readback attribution matters more than the extra RTT.
     split_drain_timing: bool = False
-    # Device circuit breaker (SURVEY.md section 5.3): the TPU link can die
-    # mid-serving (tunnel drop, preemption). After breaker_threshold
-    # CONSECUTIVE failed device dispatches/drains, host-executable requests
-    # fail over to the host SIMD interpreter instead of 400-ing one by one;
-    # after breaker_cooldown_s the next request probes the device again —
-    # one more failure re-opens instantly (the consecutive count only
-    # resets on a device success). Independent of host_spill: spill is a
-    # throughput policy, the breaker is an availability policy.
+    # Device circuit breakers (SURVEY.md section 5.3), one PER DEVICE
+    # (engine/devhealth.py): the TPU link can die mid-serving (tunnel
+    # drop, preemption) and a single chip can die alone (flaky ICI lane,
+    # bad HBM page). After breaker_threshold CONSECUTIVE failed
+    # dispatches/drains ON A DEVICE that device is quarantined — removed
+    # from the dispatchable set, its batches re-routed to healthy devices
+    # — and after breaker_cooldown_s it goes half-open: with >= 2 devices
+    # a background probe (tiny device computation) re-admits it, with 1
+    # device the next request probes it exactly as PR 4 did — one more
+    # failure re-opens instantly (the consecutive count only resets on a
+    # device success). Host failover engages only when NO device is
+    # dispatchable (for 1 device: the old global breaker, byte for
+    # byte). Independent of host_spill: spill is a throughput policy,
+    # the breaker is an availability policy.
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    # Hedged failover dispatch ("The Tail at Scale" hedged requests,
+    # bounded): when a device-path request has waited hedge_threshold_ms
+    # (floored at 50 ms and at a p99-ish multiple of the item's estimated
+    # device service time, so routine drains never hedge), a host-path
+    # twin launches speculatively and the first success wins; the loser
+    # is cancelled and releases its owed-ms charge through the existing
+    # ledger. 0 = OFF (the default: the submit path is byte-identical to
+    # the unhedged build). Hedging never applies to batch-class QoS work
+    # and never launches past the PR 4 deadline.
+    hedge_threshold_ms: float = 0.0
+    # Cap on concurrent hedges as a fraction of in-flight device items
+    # (floor 1): hedging trades bounded duplicate host work for tail
+    # latency, and an unbounded hedger would amplify exactly the overload
+    # that made the device slow.
+    hedge_budget: float = 0.05
     # Drain-hang watchdog (the breaker's blind spot): a half-dead tunnel
     # produces a MIX of instant errors — which the breaker counts — and
     # calls that block inside the runtime forever, which it cannot: the
@@ -164,6 +191,11 @@ class ExecutorStats:
     breaker_opens: int = 0  # times the circuit breaker tripped
     breaker_host_served: int = 0  # requests served by host during an outage
     shadow_probes: int = 0  # discarded device rides that refresh the cost model
+    hedges_launched: int = 0  # host-path twins actually started
+    hedges_won: int = 0  # twin finished first; the device item was cancelled
+    hedges_lost: int = 0  # device finished first; twin result discarded
+    hedges_failed: int = 0  # twin raised (device path still owns the request)
+    hedges_skipped: int = 0  # eligible but budget-capped
     device_ms_per_mb: float = 0.0  # measured drain cost per wire megabyte
     host_ms_per_mpix: float = 0.0  # measured host CPU cost per megapixel
     host_inflight: int = 0  # spilled items executing on host threads right now
@@ -190,6 +222,15 @@ class ExecutorStats:
             "breaker_opens": self.breaker_opens,
             "breaker_host_served": self.breaker_host_served,
             "shadow_probes": self.shadow_probes,
+            # nested so /metrics can render one labeled family
+            # (imaginary_tpu_hedges_total{outcome=}) instead of five
+            "hedges": {
+                "launched": self.hedges_launched,
+                "won": self.hedges_won,
+                "lost": self.hedges_lost,
+                "failed": self.hedges_failed,
+                "skipped_budget": self.hedges_skipped,
+            },
             "device_ms_per_mb": round(self.device_ms_per_mb, 3),
             "host_ms_per_mpix": round(self.host_ms_per_mpix, 3),
             "host_inflight": self.host_inflight,
@@ -259,7 +300,7 @@ def last_placement() -> Optional[str]:
 
 class _Item:
     __slots__ = ("arr", "plan", "future", "key", "t", "wire_mb", "mpix",
-                 "qos")
+                 "qos", "trace")
 
     def __init__(self, arr: np.ndarray, plan: ImagePlan):
         self.arr = arr
@@ -268,6 +309,11 @@ class _Item:
         # (tenant, class_index, max_share, deadline_t) stamped by submit()
         # when a qos policy is active; None rides the FIFO path untouched
         self.qos = None
+        # The submitting request's RequestTrace (or None): the collector
+        # runs on its own thread where the contextvar is gone, so the
+        # placement ladder (`placement_attempts`) is stamped through this
+        # reference — per-request chip attribution, not batch-scoped.
+        self.trace = None
         if plan.in_bucket is not None:  # packed transport: pre-padded array
             hb, wb = plan.in_bucket
             in_h, in_w = plan.in_h, plan.in_w
@@ -311,6 +357,7 @@ class Executor:
             self._queue = queue_mod.Queue()
         self._sharding = None
         self._spatial_sharding = None
+        self._full_sharding = None  # pristine mesh sharding (no quarantines)
         self._mesh_batch = 1
         self._mesh_spatial = 1
         if self.config.use_mesh:
@@ -326,6 +373,7 @@ class Executor:
             self._sharding = batch_sharding(mesh)
             self._mesh_batch = mesh.devices.shape[0]
             self._mesh_spatial = mesh.devices.shape[1]
+            self._full_sharding = self._sharding
             if mesh.devices.shape[1] > 1:
                 # (batch, H, W, C) with W split over the spatial axis —
                 # same partitioning the driver dryrun validates numerically
@@ -350,8 +398,31 @@ class Executor:
         # cheap-key bytes at an expensive arrival's rate.
         self._owed_ms = 0.0
         self._owed_lock = threading.Lock()
-        self._consec_device_failures = 0
-        self._breaker_open_until = 0.0  # monotonic; 0 = closed
+        # Per-device fault domains (engine/devhealth.py). Starts at ONE
+        # domain — device enumeration initializes the backend, which
+        # belongs to the first dispatch (a dead tunnel would hang the
+        # boot), so _resolve_devices() grows the registry lazily from the
+        # collector thread. For one device the registry's breaker IS the
+        # PR 4 global breaker (same trip rule, same half-open-on-request
+        # semantics); _breaker_open_until/_consec_device_failures remain
+        # as property shims over device 0's record.
+        self.devhealth = DeviceHealthRegistry(
+            1, threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s)
+        self._devices: Optional[list] = None  # resolved at first dispatch
+        self._mesh = None
+        if self._sharding is not None:
+            # mesh mode already touched the backend above: enumerate now
+            self._mesh = self._sharding.mesh
+            self._devices = list(self._mesh.devices.flat)
+            self.devhealth.resize(len(self._devices))
+            if len(self._devices) > 1:
+                self.devhealth.start_probing(self._probe_device)
+        self._devhealth_gen = 0
+        # in-flight device items + live hedge count (the hedge budget's
+        # denominator/numerator), guarded by _owed_lock
+        self._device_items = 0
+        self._hedges_inflight = 0
         self._device_ms_per_mb: Optional[float] = None  # EWMA, fetcher-updated
         # prewarm-measured starting estimate; a 0.0 rate is "unpriced", not
         # "free" — the EWMA's multiplicative clamps could never leave 0
@@ -461,20 +532,27 @@ class Executor:
             fetch_gen = self._fetch_gen
         with self._owed_lock:
             owed_ms = self._owed_ms
-            breaker_until = self._breaker_open_until
-            consec = self._consec_device_failures
             rate_keys = len(self._rate_by_key)
             host_inflight = self._host_inflight
             host_owed = self._host_owed_mpix
+            hedges_inflight = self._hedges_inflight
+            device_items = self._device_items
+        breaker_until = self._breaker_open_until
+        consec = self._consec_device_failures
         snap = {
             "queue_depth": self.stats.queue_depth,
             "inflight_groups": inflight_groups,
             "drain_in_flight_age_s": drain_age_s,
             "fetcher_generation": fetch_gen,
             "owed_ms": round(owed_ms, 3),
-            "breaker_open": now < breaker_until,
+            "breaker_open": self._breaker_is_open(),
             "breaker_open_for_s": round(max(0.0, breaker_until - now), 3),
             "consecutive_device_failures": consec,
+            # per-device fault domains (engine/devhealth.py): the same
+            # block /health serves as `devices`
+            "devices": self.devhealth.snapshot(),
+            "hedges_inflight": hedges_inflight,
+            "device_items_inflight": device_items,
             "rate_keys": rate_keys,
             "device_ms_per_mb": round(self._device_ms_per_mb or 0.0, 3),
             "drain_floor_ms": round(self._drain_floor_ms or 0.0, 3),
@@ -507,6 +585,7 @@ class Executor:
             from imaginary_tpu.qos.tenancy import request_qos
 
             item.qos = request_qos(self.config.qos)
+        item.trace = obs_trace.current()
         _PLACEMENT.value = "device"
         if not plan.stages:  # identity chain: no device work at all
             item.future.set_result(arr)
@@ -523,6 +602,8 @@ class Executor:
             else:
                 self.stats.breaker_host_served += 1
                 _PLACEMENT.value = "host"
+                self._stamp_attempts(
+                    [item], ["device:quarantined", "host_fallback"])
                 item.future.set_result(out)
                 return item.future
         forced = self.config.force_host and host_exec.can_execute(
@@ -567,6 +648,7 @@ class Executor:
                     self.stats.host_ms_per_mpix = self._host_ms_per_mpix
                 self.stats.spilled += 1
                 _PLACEMENT.value = "host"
+                self._stamp_attempts([item], ["host_spill"])
                 item.future.set_result(out)
                 return item.future
             finally:
@@ -584,6 +666,10 @@ class Executor:
             # take this branch.
             item.future.cancel()
             raise
+        if self.config.hedge_threshold_ms > 0:
+            outer = self._arm_hedge(item)
+            if outer is not None:
+                return outer
         return item.future
 
     def _host_charge(self, mpix: float) -> None:
@@ -606,6 +692,7 @@ class Executor:
         est_ms = item.wire_mb * self._rate_for(item.key)
         with self._owed_lock:
             self._owed_ms += est_ms
+            self._device_items += 1  # the hedge budget's denominator
         item.future.add_done_callback(lambda _f: self._on_done(est_ms))
 
     def _rate_for(self, key) -> float:
@@ -622,31 +709,102 @@ class Executor:
     def _on_done(self, est_ms: float) -> None:
         with self._owed_lock:
             self._owed_ms -= est_ms
+            self._device_items -= 1
+
+    # PR 4 shims: the global breaker's fields live on in tests and
+    # operator muscle memory as device 0's record (the degenerate
+    # 1-device fault domain). Reads/writes go straight through.
+    @property
+    def _breaker_open_until(self) -> float:
+        return self.devhealth.record(0).quarantined_until
+
+    @_breaker_open_until.setter
+    def _breaker_open_until(self, v: float) -> None:
+        with self.devhealth._lock:
+            self.devhealth._records[0].quarantined_until = v
+
+    @property
+    def _consec_device_failures(self) -> int:
+        return self.devhealth.record(0).consecutive_failures
+
+    @_consec_device_failures.setter
+    def _consec_device_failures(self, v: int) -> None:
+        self.devhealth.set_consecutive(0, v)
 
     def _breaker_is_open(self) -> bool:
-        with self._owed_lock:
-            return time.monotonic() < self._breaker_open_until
+        """Host failover engages only when NO device is dispatchable —
+        for one device, exactly the PR 4 global breaker."""
+        return not self.devhealth.any_available()
 
-    def _note_device_failure(self) -> None:
-        """One failed dispatch/drain EVENT (a batch, not per item)."""
+    def _note_device_failure(self, idx: int = 0, err: object = None) -> None:
+        """One failed dispatch/drain EVENT (a batch, not per item),
+        attributed to device `idx`'s fault domain. A trip (or half-open
+        re-trip) quarantines that device alone; the consecutive count
+        persists through cooldown so one more failure re-opens instantly,
+        and only a device success resets it. stats.breaker_opens counts
+        FLEET-WIDE outage events — a trip that leaves no dispatchable
+        device (for one device: every trip, the PR 4 number verbatim);
+        per-device trips ride the registry snapshot."""
+        tripped = self.devhealth.note_failure(idx, err)
         with self._owed_lock:
-            self._consec_device_failures += 1
             self.stats.device_failures += 1
-            if (
-                self._consec_device_failures >= self.config.breaker_threshold
-                and time.monotonic() >= self._breaker_open_until
-            ):
-                self._breaker_open_until = (
-                    time.monotonic() + self.config.breaker_cooldown_s
-                )
+            if tripped and not self.devhealth.any_available():
                 self.stats.breaker_opens += 1
-                # count persists: after cooldown ONE more failure re-opens;
-                # only a device success resets it
 
-    def _note_device_ok(self) -> None:
-        with self._owed_lock:
-            self._consec_device_failures = 0
-            self._breaker_open_until = 0.0
+    def _note_link_failure(self, err: object = None) -> None:
+        """A failure with no chip attribution — the device.execute chaos
+        site, or a drain hang: the dispatch/readback path is SHARED, so
+        the conservative read is that every dispatchable domain is
+        affected (for one device this reduces to _note_device_failure,
+        byte for byte). One stats EVENT per affected domain."""
+        for idx in (self.devhealth.available_indices() or [0]):
+            self._note_device_failure(idx, err)
+
+    def _note_device_ok(self, idx: int = 0,
+                        latency_ms: Optional[float] = None) -> None:
+        self.devhealth.note_ok(idx, latency_ms=latency_ms)
+
+    def _resolve_devices(self) -> None:
+        """Enumerate dispatchable devices, once, from the collector thread
+        (first dispatch touches the backend anyway; doing this in
+        __init__ would hang app assembly on a dead accelerator tunnel).
+        With > 1 device the registry grows to one fault domain per chip
+        and the background re-admission prober starts."""
+        if self._devices is not None:
+            return
+        try:
+            import jax
+
+            devs = list(jax.local_devices())
+        except Exception:  # pragma: no cover - backend init failure
+            devs = []
+        if self.config.n_devices:
+            devs = devs[: self.config.n_devices]
+        self._devices = devs
+        if len(devs) > 1:
+            self.devhealth.resize(len(devs))
+            self.devhealth.start_probing(self._probe_device)
+
+    def _probe_device(self, idx: int) -> None:
+        """Half-open re-admission probe: a tiny computation pinned to
+        device `idx`, raising on failure. Runs the chip_error failpoint
+        too — an injected sick chip must fail its probe exactly as a real
+        one would, or chaos runs would re-admit mid-fault and flap."""
+        failpoints.hit("device.chip_error", key=idx)
+        import jax
+
+        devs = self._devices
+        dev = devs[idx] if devs and idx < len(devs) else None
+        x = jax.device_put(np.zeros((8,), np.float32), dev)
+        (x + 1.0).block_until_ready()
+
+    @staticmethod
+    def _stamp_attempts(items: list, attempts: list) -> None:
+        """Record the placement ladder on each item's originating request
+        trace (wide events / slow ring / Server-Timing ride along)."""
+        for it in items:
+            if it.trace is not None:
+                it.trace.annotate(placement_attempts=list(attempts))
 
     def _should_spill(self, item: "_Item") -> bool:
         if self._device_ms_per_mb is None:
@@ -759,12 +917,149 @@ class Executor:
             return
         self.stats.shadow_probes += 1
 
+    # -- hedged failover dispatch ---------------------------------------------
+
+    def _hedge_threshold_ms_for(self, item: "_Item") -> float:
+        """Effective hedge trigger for one item: the operator floor, a
+        hard 50 ms floor (sub-50ms hedging just duplicates healthy work),
+        and a p99-ish multiple (4x) of the item's own estimated device
+        service time so a legitimately big chain on a slow link doesn't
+        hedge on every request."""
+        est = (self._drain_floor_ms or 0.0) + item.wire_mb * self._rate_for(item.key)
+        return max(self.config.hedge_threshold_ms, 50.0, 4.0 * est)
+
+    def _arm_hedge(self, item: "_Item") -> Optional[Future]:
+        """Wrap a queued device item in a hedged OUTER future: if the
+        device path hasn't resolved within the threshold, a host-path
+        twin launches and the first success wins. Returns None when the
+        item is ineligible (batch-class QoS, host-inexecutable plan, or
+        too close to its PR 4 deadline) — the caller then returns the
+        plain device future, byte-identical to the unhedged path."""
+        if item.qos is not None and item.qos[1] == _BATCH_CLASS:
+            return None  # batch work must never amplify into host capacity
+        if not host_exec.can_execute(item.plan, for_spill=False):
+            return None
+        threshold_ms = self._hedge_threshold_ms_for(item)
+        dl = item.trace.deadline if item.trace is not None else None
+        if dl is not None and dl.remaining_s() * 1000.0 <= threshold_ms:
+            return None  # the deadline would fire first; hedging is moot
+        outer: Future = Future()
+        lock = threading.Lock()
+        state = {"exc": None, "running": False}
+        timer = threading.Timer(threshold_ms / 1000.0, self._fire_hedge,
+                                args=(item, outer, lock, state))
+        timer.daemon = True
+
+        def on_primary(f: Future) -> None:
+            timer.cancel()
+            with lock:
+                if outer.done():
+                    return  # twin already won (it cancelled this future)
+                if f.cancelled():
+                    outer.cancel()
+                    return
+                exc = f.exception()
+                if exc is None:
+                    try:
+                        outer.set_result(f.result())
+                    except Exception:  # racing cancel; result stands down
+                        pass
+                    return
+                if state["running"]:
+                    # a twin is mid-flight: it may still save the request;
+                    # stash the device error for it to surface on failure
+                    state["exc"] = exc
+                    return
+                try:
+                    outer.set_exception(exc)
+                except Exception:
+                    pass
+
+        def on_outer(f: Future) -> None:
+            # deadline path (handlers) cancels the OUTER future: the
+            # device item must cancel too so its owed-ms charge releases
+            if f.cancelled():
+                timer.cancel()
+                item.future.cancel()
+
+        item.future.add_done_callback(on_primary)
+        outer.add_done_callback(on_outer)
+        timer.start()
+        return outer
+
+    def _fire_hedge(self, item: "_Item", outer: Future, lock, state) -> None:
+        """Timer body: launch the host twin if the device path is still
+        pending and the hedge budget allows it. Runs on the timer's own
+        thread — host_exec.run is GIL-released SIMD work, the same cost a
+        spill would have paid."""
+        with lock:
+            if outer.done() or item.future.done():
+                return
+            with self._owed_lock:
+                allowed = max(1, int(self.config.hedge_budget
+                                     * max(1, self._device_items)))
+                if self._hedges_inflight >= allowed:
+                    self.stats.hedges_skipped += 1
+                    return
+                self._hedges_inflight += 1
+                self.stats.hedges_launched += 1
+            state["running"] = True
+        won = False
+        try:
+            out = host_exec.run(item.arr, item.plan)
+        except Exception:
+            with lock:
+                state["running"] = False
+                with self._owed_lock:
+                    self.stats.hedges_failed += 1
+                exc = state["exc"]
+                if exc is not None and not outer.done():
+                    # both paths failed: surface the DEVICE error (the
+                    # twin was speculative; its failure is secondary)
+                    try:
+                        outer.set_exception(exc)
+                    except Exception:
+                        pass
+        else:
+            with lock:
+                state["running"] = False
+                if not outer.done():
+                    outer._hedge_placement = "host"
+                    try:
+                        outer.set_result(out)
+                        won = True
+                    except Exception:
+                        won = False
+                with self._owed_lock:
+                    if won:
+                        self.stats.hedges_won += 1
+                    else:
+                        self.stats.hedges_lost += 1
+            if won:
+                # cancelled loser: the done-callback releases its owed-ms
+                # charge through the existing ledger; an already-dispatched
+                # item finishes on the device and is discarded (hedging
+                # never ADDS device dispatches, only host ones)
+                item.future.cancel()
+            if item.trace is not None:
+                item.trace.annotate(hedge="won" if won else "lost")
+        finally:
+            with self._owed_lock:
+                self._hedges_inflight -= 1
+
     def process(self, arr: np.ndarray, plan: ImagePlan, timeout: float = 120.0) -> np.ndarray:
         """Blocking convenience wrapper."""
-        return self.submit(arr, plan).result(timeout=timeout)
+        fut = self.submit(arr, plan)
+        out = fut.result(timeout=timeout)
+        hp = getattr(fut, "_hedge_placement", None)
+        if hp:
+            # a hedge twin won: pixels came from the host interpreter
+            _PLACEMENT.value = hp
+        return out
 
     def shutdown(self):
         self._running = False
+        self.devhealth.close()  # stop the re-admission prober
         self._queue.put(None)
         self._thread.join(timeout=30)
         # the collector enqueues the fetcher's sentinel itself, after its
@@ -840,9 +1135,11 @@ class Executor:
             self._dispatch(items)
         self._fetch_queue.put(None)
 
-    def _launch_chunk(self, items: list):
-        """Launch one device call of <= max_batch items; returns
-        (device_out, padded_arrs, padded_plans) or raises."""
+    def _launch_chunk(self, items: list, device=None):
+        """Launch one device call of <= max_batch items — on an explicit
+        `device` when per-device routing chose one (multi-device,
+        unsharded) — returns (device_out, padded_arrs, padded_plans) or
+        raises."""
         n = len(items)
         arrs = [it.arr for it in items]
         plans = [it.plan for it in items]
@@ -868,15 +1165,121 @@ class Executor:
         ):
             sharding = self._spatial_sharding
             self.stats.spatial_batches += 1
-        y = chain_mod.launch_batch(arrs, plans, sharding=sharding)
+        y = chain_mod.launch_batch(arrs, plans, sharding=sharding,
+                                   device=device)
         return y, arrs, plans
 
+    def _refresh_mesh_sharding(self) -> None:
+        """Mesh mode's quarantine story: when the registry's generation
+        moves (a chip quarantined or re-admitted), rebuild the batch
+        sharding over the AVAILABLE chips (parallel/mesh.healthy_mesh).
+        Degraded meshes drop the spatial axis — W-sharding a huge image
+        across a set that includes a dead chip would fail the whole
+        launch, and serving 4K from fewer chips beats not serving it."""
+        gen = self.devhealth.generation
+        if gen == self._devhealth_gen or self._mesh is None:
+            return
+        self._devhealth_gen = gen
+        avail = set(self.devhealth.available_indices())
+        if len(avail) >= len(self._devices or ()):
+            from imaginary_tpu.parallel import batch_sharding
+
+            self._sharding = self._full_sharding or batch_sharding(self._mesh)
+            self._mesh_batch = self._mesh.devices.shape[0]
+            self._mesh_spatial = self._mesh.devices.shape[1]
+            return
+        from imaginary_tpu.parallel.mesh import batch_sharding, healthy_mesh
+
+        m = healthy_mesh(self._mesh, avail)
+        if m is None:
+            return  # nothing available: the breaker path owns this outage
+        self._sharding = batch_sharding(m)
+        self._mesh_batch = m.devices.shape[0]
+        self._mesh_spatial = 1
+        self._spatial_sharding = None
+
+    def _launch_with_failover(self, sub: list):
+        """The dispatch half of the placement ladder: device(n) →
+        device(other) → fail (submit-time rungs — host_spill and the
+        breaker's host_fallback — run before items reach this queue, and
+        admission owns the final shed-503 rung). Launch one chunk on a
+        chosen healthy device; a launch failure books a strike against
+        THAT device's fault domain and retries on the next healthy one,
+        so losing a chip costs capacity, not availability. Returns the
+        chunk tuple (y, arrs, plans, sub, device_idx) or None with the
+        futures already failed."""
+        if self._sharding is not None:
+            # mesh launch spans every chip in the current sharding: a
+            # failure is not attributable to one of them, so all current
+            # domains take the strike (a 1-chip mesh reduces to PR 4)
+            self._refresh_mesh_sharding()
+            try:
+                failpoints.hit("device.chip_error")
+                y, arrs, plans = self._launch_chunk(sub)
+            except Exception as e:
+                self._note_link_failure(e)
+                self._stamp_attempts(sub, ["device:mesh:error"])
+                for it in sub:
+                    if not it.future.done():
+                        it.future.set_exception(e)
+                return None
+            self._stamp_attempts(sub, ["device:mesh"])
+            return (y, arrs, plans, sub, None)
+        multi = self._devices is not None and len(self._devices) > 1
+        tried: set = set()
+        attempts: list = []
+        err: Optional[Exception] = None
+        while True:
+            idx = self.devhealth.pick(exclude=tried)
+            if idx is None:
+                if tried:
+                    break
+                # every domain is hard-quarantined: attempt the primary
+                # anyway so device-only plans surface the REAL device
+                # error (PR 4 semantics), not a synthetic one
+                idx = 0
+            tried.add(idx)
+            # Explicit placement ONLY for failover targets (idx != 0):
+            # the primary domain IS the default device, and pinning it
+            # explicitly would fork the jit compile-cache key away from
+            # everything prewarm.py warmed (device=None), making every
+            # prewarmed chain recompile at first request. The 1-device
+            # path therefore stays byte-identical to the PR 4 build, and
+            # a failover launch pays its own (cold-detected) compile only
+            # during an actual outage.
+            dev = self._devices[idx] if multi and idx != 0 else None
+            try:
+                # chaos site, keyed by device index: chip_error[k] kills
+                # chip k specifically while its peers keep serving
+                failpoints.hit("device.chip_error", key=idx)
+                y, arrs, plans = self._launch_chunk(sub, device=dev)
+            except Exception as e:
+                err = e
+                self._note_device_failure(idx, e)
+                attempts.append(f"device:{idx}:error")
+                continue
+            attempts.append(f"device:{idx}")
+            self._stamp_attempts(sub, attempts)
+            return (y, arrs, plans, sub, idx)
+        self._stamp_attempts(sub, attempts)
+        e = err if err is not None else RuntimeError(
+            "no dispatchable device (all fault domains quarantined)")
+        for it in sub:
+            # done() covers deadline-cancelled futures: set_exception on
+            # a cancelled future raises InvalidStateError and would kill
+            # the collector thread
+            if not it.future.done():
+                it.future.set_exception(e)
+        return None
+
     def _dispatch(self, items: list):
-        """Launch a group as chunk-sized device calls; enqueue ONE fetch
-        task covering all of them, so the fetcher drains the whole group
-        with a single parallel device_get (measured ~1.4x the bandwidth of
-        a serial per-buffer fetch, and the per-drain fixed cost amortizes
-        over the group, not the chunk)."""
+        """Launch a group as chunk-sized device calls routed through the
+        per-device fault domains; enqueue ONE fetch task covering all of
+        them, so the fetcher drains the whole group with a single
+        parallel device_get (measured ~1.4x the bandwidth of a serial
+        per-buffer fetch, and the per-drain fixed cost amortizes over the
+        group, not the chunk)."""
+        self._resolve_devices()
         chunks = []
         now = time.monotonic()
         for it in items:
@@ -887,25 +1290,30 @@ class Executor:
             # IS the dispatch path), error() a failed dispatch — which
             # books a device failure and, consecutively, opens the breaker
             failpoints.hit("device.execute")
-            for start in range(0, len(items), self.config.max_batch):
-                sub = items[start : start + self.config.max_batch]
-                y, arrs, plans = self._launch_chunk(sub)
-                chunks.append((y, arrs, plans, sub))
         except Exception as e:
-            self._note_device_failure()
+            # collector-level failure: no chip attribution, strike the link
+            self._note_link_failure(e)
+            self._stamp_attempts(items, ["device:link:error"])
             for it in items:
-                # done() covers deadline-cancelled futures: set_exception
-                # on a cancelled future raises InvalidStateError and would
-                # kill the collector thread
                 if not it.future.done():
                     it.future.set_exception(e)
+            return
+        launched = 0
+        for start in range(0, len(items), self.config.max_batch):
+            sub = items[start : start + self.config.max_batch]
+            chunk = self._launch_with_failover(sub)
+            if chunk is None:
+                continue  # that chunk's futures already carry the error
+            chunks.append(chunk)
+            launched += len(sub)
+        if not chunks:
             return
         # A cache-size bump means this group's launch paid an XLA compile;
         # its drain time must not seed the cost model (a multi-second compile
         # divided over one group would lock thousands of requests into host
         # spill before the EWMA recovered — ADVICE r1).
         cold = chain_mod.cache_size() > cache_before
-        self.stats.items += len(items)
+        self.stats.items += launched
         self.stats.groups += 1
         self.stats.batches += len(chunks)
         self.stats.max_group_seen = max(self.stats.max_group_seen, len(items))
@@ -941,16 +1349,19 @@ class Executor:
                 f"device drain exceeded {budget:.0f}s watchdog; "
                 "link presumed hung"
             )
-            for _, _, _, sub in chunks:
-                for it in sub:
+            for c in chunks:
+                for it in c[3]:
                     if not it.future.done():
                         it.future.set_exception(err)
             # a hung link is unambiguous: open the breaker outright so
             # host-executable traffic fails over immediately (pre-load the
-            # consecutive count so the one shared transition site trips)
-            with self._owed_lock:
-                self._consec_device_failures = self.config.breaker_threshold - 1
-            self._note_device_failure()
+            # consecutive count so the one shared transition site trips).
+            # The D2H path is SHARED — a wedged drain condemns every
+            # dispatchable domain, not just the chunk's chips.
+            for idx in (self.devhealth.available_indices() or [0]):
+                self.devhealth.set_consecutive(
+                    idx, self.config.breaker_threshold - 1)
+                self._note_device_failure(idx, err)
             # groups queued behind the hung drain would block until the
             # zombie thread unblocked (possibly never): fail them now
             while True:
@@ -961,8 +1372,8 @@ class Executor:
                 if got is None:
                     self._fetch_queue.put(None)
                     break
-                for _, _, _, sub in got[0]:
-                    for it in sub:
+                for c in got[0]:
+                    for it in c[3]:
                         if not it.future.done():
                             it.future.set_exception(err)
                 with self._inflight_lock:
@@ -1008,9 +1419,16 @@ class Executor:
                         self._drain_state = None
                 if not live:
                     return  # watchdog already failed the futures + inflight
-                self._note_device_failure()
-                for _, _, _, sub in chunks:
-                    for it in sub:
+                # a failed drain strikes every fault domain it rode (one
+                # EVENT per device; for one device this is the PR 4 "one
+                # failure per drain error", byte for byte)
+                idxs = sorted({c[4] for c in chunks if c[4] is not None})
+                if not idxs:
+                    idxs = self.devhealth.available_indices() or [0]
+                for idx in idxs:
+                    self._note_device_failure(idx, e)
+                for c in chunks:
+                    for it in c[3]:
                         if not it.future.done():
                             it.future.set_exception(e)
                 with self._inflight_lock:
@@ -1026,7 +1444,13 @@ class Executor:
                 # the queue — discard the zombie results and exit without
                 # touching the breaker, the EWMAs, or inflight
                 return
-            self._note_device_ok()
+            drained_idxs = sorted({c[4] for c in chunks if c[4] is not None})
+            if not drained_idxs:
+                drained_idxs = self.devhealth.available_indices() or [0]
+            ok_latency = ((time.monotonic() - t0) * 1000.0
+                          / max(1, len(chunks)))
+            for idx in drained_idxs:
+                self._note_device_ok(idx, latency_ms=ok_latency)
             # A drain costs fixed + MB x rate (the link's round-trip floor
             # plus bandwidth). The per-MB estimator must book only the
             # BANDWIDTH part: subtract the learned fixed floor — the
@@ -1084,7 +1508,7 @@ class Executor:
                     else:
                         k = min(per_mb, 4.0 * kprev)
                         self._rate_by_key[key] = 0.7 * kprev + 0.3 * k
-            for host_y, (y, arrs, plans, sub) in zip(fetched, chunks):
+            for host_y, (y, arrs, plans, sub, _idx) in zip(fetched, chunks):
                 try:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)
                 except Exception as e:

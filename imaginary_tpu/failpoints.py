@@ -19,13 +19,24 @@ Sites (each named for the subsystem boundary it sits on):
   codec.decode     host image decode (pipeline.py, pool thread)
   executor.submit  micro-batch executor entry (engine/executor.py)
   device.execute   device dispatch inside the collector (engine/executor.py)
+  device.chip_error  one chunk launch on one DEVICE (engine/executor.py);
+                   keyable by device index — `device.chip_error[1]=error`
+                   kills chip 1 specifically while chip 0 keeps serving,
+                   which is how the chip-loss chaos row quarantines a
+                   single fault domain
+  worker.hang      the /health handler, SYNCHRONOUSLY (web/handlers.py):
+                   a delay() here blocks the worker's event loop for the
+                   duration — the "process alive, loop wedged" failure
+                   the supervisor's liveness probe exists to catch
   host.spill       the host SIMD spill branch (engine/executor.py)
   codec.encode     host image encode (pipeline.py, pool thread)
   cache.get        any cache-tier lookup (cache.py ByteBudgetLRU)
 
 Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
 
-  SPEC    := SITE=ACTION [";" SITE=ACTION]*
+  SPEC    := SITE["[" KEY "]"]=ACTION [";" ...]*   KEY scopes a keyable
+                                      site to one instance (device index);
+                                      a bare SITE matches every key
   ACTION  := error["(" P ")"]          raise FailpointError, probability P (default 1)
            | delay "(" DURATION ")"    sleep, then continue normally
            | timeout["(" DURATION ")"] sleep DURATION (default 60s), then raise
@@ -58,10 +69,15 @@ SITES = (
     "codec.decode",
     "executor.submit",
     "device.execute",
+    "device.chip_error",
+    "worker.hang",
     "host.spill",
     "codec.encode",
     "cache.get",
 )
+
+# keyed-site spelling: site[key], key limited to a safe token charset
+_KEYED_SITE_RE = re.compile(r"^([\w.]+)\[([\w-]+)\]$")
 
 ENV_VAR = "IMAGINARY_TPU_FAILPOINTS"
 
@@ -138,9 +154,11 @@ def parse(spec: str) -> dict:
             raise ValueError(f"bad failpoint clause {part!r} (want site=action)")
         site, action = part.split("=", 1)
         site = site.strip()
-        if site not in SITES:
+        m = _KEYED_SITE_RE.match(site)
+        base = m.group(1) if m else site
+        if base not in SITES:
             raise ValueError(
-                f"unknown failpoint site {site!r} (known: {', '.join(SITES)})")
+                f"unknown failpoint site {base!r} (known: {', '.join(SITES)})")
         out[site] = _parse_action(action)
     return out
 
@@ -205,29 +223,38 @@ def snapshot() -> dict:
     return {"enabled": bool(_active), "spec": active_spec(), "sites": sites}
 
 
-def _decide(site: str) -> Optional[_Spec]:
+def _decide(site: str, key=None) -> Optional[_Spec]:
     active = _active
     if not active:
         return None
-    sp = active.get(site)
+    # keyed lookup first (`device.chip_error[1]` arms chip 1 alone); a
+    # bare site spec matches every key of a keyable site
+    name = site
+    sp = None
+    if key is not None:
+        name = f"{site}[{key}]"
+        sp = active.get(name)
+    if sp is None:
+        name = site
+        sp = active.get(site)
     if sp is None:
         return None
     with _lock:
-        c = _counts.setdefault(site, [0, 0])
+        c = _counts.setdefault(name, [0, 0])
         c[0] += 1
         if sp.p < 1.0 and random.random() >= sp.p:
             return None
         c[1] += 1
         if sp.once:
             # spent: drop from the active map (snapshot keeps the counts)
-            active.pop(site, None)
+            active.pop(name, None)
     return sp
 
 
-def hit(site: str) -> None:
+def hit(site: str, key=None) -> None:
     """Synchronous injection site (pool/collector threads). No-op unless
-    armed for `site`."""
-    sp = _decide(site)
+    armed for `site` (or its `site[key]` spelling when `key` is given)."""
+    sp = _decide(site, key)
     if sp is None:
         return
     if sp.kind == "delay":
@@ -239,11 +266,11 @@ def hit(site: str) -> None:
     raise FailpointError(f"failpoint {site}: injected error")
 
 
-async def ahit(site: str) -> None:
+async def ahit(site: str, key=None) -> None:
     """Async injection site (event-loop paths). `timeout` raises
     asyncio.TimeoutError so callers' timeout classification (e.g. the
     origin-fetch 504 mapping) fires exactly as on a real stall."""
-    sp = _decide(site)
+    sp = _decide(site, key)
     if sp is None:
         return
     if sp.kind == "delay":

@@ -24,6 +24,12 @@ Schema (stable field names — tests/test_obs.py pins them):
   cache         off | result_miss | result_hit | etag_304
   coalesced     true when this request waited on another's pipeline run
   placement     device | host (where the pixels were computed)
+  placement_attempts  the placement ladder this request actually walked:
+                device:K / device:K:error (per-chip dispatch attempts),
+                device:mesh, device:link:error, device:quarantined,
+                host_spill, host_fallback, shed_503 — stamped by
+                engine/executor.py + the admission gate
+  hedge         won | lost (only when a hedged host twin launched)
   tenant        resolved qos tenant name (only with --qos-config)
   qos_class     interactive | standard | batch (only with --qos-config)
   spans         [{name, start_ms, dur_ms}] full timeline

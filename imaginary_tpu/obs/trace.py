@@ -11,6 +11,11 @@ thread (decode/encode/host_spill via engine/timing.py's stage hook)
 attribute to the right request. Stages recorded on the executor's own
 collector/fetcher threads (queue_wait, drain) aggregate in /metrics but
 are not per-request attributable — by design, they are batch-scoped.
+The one exception is the PLACEMENT LADDER: each queued executor item
+carries a reference to its request's trace, so the collector stamps the
+per-chip dispatch attempts (`placement_attempts`, engine/executor.py)
+onto the right request even though it runs on its own thread —
+annotate() takes the trace lock, so cross-thread stamps are safe.
 
 Identity follows W3C Trace Context: an inbound `traceparent` header is
 honored (same trace-id continues, our span becomes a child); outbound

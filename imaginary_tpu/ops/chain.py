@@ -53,8 +53,23 @@ def _sharding_cache_key(sharding):
         return repr(sharding)
 
 
-def _compiled(specs: tuple, in_shape: tuple, dyn_shapes_key: tuple, shard_key=None):
-    key = (specs, in_shape, dyn_shapes_key, shard_key)
+def _device_cache_key(device):
+    """Hashable descriptor of an explicit device placement (per-device
+    fault-domain routing, engine/executor.py). Part of the compile-cache
+    key for the same reason _sharding_cache_key is: the first launch of a
+    signature on a NEW device recompiles inside jax.jit, and the
+    executor's cold-drain detector must see that as a cache-size bump."""
+    if device is None:
+        return None
+    try:
+        return (device.platform, device.id)
+    except AttributeError:  # pragma: no cover - exotic device objects
+        return repr(device)
+
+
+def _compiled(specs: tuple, in_shape: tuple, dyn_shapes_key: tuple, shard_key=None,
+              device_key=None):
+    key = (specs, in_shape, dyn_shapes_key, shard_key, device_key)
     fn = _CACHE.get(key)
     if fn is None:
         with _LOCK:
@@ -69,7 +84,8 @@ def cache_size() -> int:
     return len(_CACHE)
 
 
-def single_is_warm(arr: np.ndarray, plan: ImagePlan, sharding=None) -> bool:
+def single_is_warm(arr: np.ndarray, plan: ImagePlan, sharding=None,
+                   device=None) -> bool:
     """True when a batch-of-one launch of this (chain, bucket) pair would
     hit the compile cache. Used to gate cost-model shadow probes: a probe
     measures the LINK, and paying a fresh XLA compile (minutes on a CPU
@@ -87,7 +103,8 @@ def single_is_warm(arr: np.ndarray, plan: ImagePlan, sharding=None) -> bool:
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
-    return (specs, shape, dyn_key, _sharding_cache_key(sharding)) in _CACHE
+    return (specs, shape, dyn_key, _sharding_cache_key(sharding),
+            _device_cache_key(device)) in _CACHE
 
 
 def clear_cache() -> None:
@@ -116,13 +133,16 @@ def _stack_dyns(plans: list) -> tuple:
     return tuple(out)
 
 
-def launch_batch(arrs: list, plans: list, sharding=None):
+def launch_batch(arrs: list, plans: list, sharding=None, device=None):
     """Stage + dispatch one batched device call WITHOUT waiting for it.
 
     arrs: list of HWC uint8 arrays, all with the same bucket shape and C.
     plans: matching ImagePlans with identical spec_key().
     sharding: optional NamedSharding over the leading batch dim — inputs are
     placed with it and the jitted program partitions over the mesh.
+    device: optional explicit jax.Device — inputs are placed there and the
+    computation follows them (per-device fault-domain routing; mutually
+    exclusive with sharding, which wins when both are given).
     Returns the device output array (uint8, still computing), or None for an
     identity chain. JAX dispatch is async, so host->device transfer and
     compute proceed while the caller pipelines further batches; pair with
@@ -159,10 +179,21 @@ def launch_batch(arrs: list, plans: list, sharding=None):
         dyns = tuple(
             {k: jax.device_put(v, vec_sharding) for k, v in d.items()} for d in dyns
         )
+    elif device is not None:
+        # pin the whole call to one device: jit follows the operands'
+        # placement, so a quarantine-routed batch never touches the sick
+        # chip it was steered away from
+        batch = jax.device_put(batch, device)
+        h = jax.device_put(h, device)
+        w = jax.device_put(w, device)
+        dyns = tuple(
+            {k: jax.device_put(v, device) for k, v in d.items()} for d in dyns
+        )
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
-    fn = _compiled(specs, batch.shape, dyn_key, _sharding_cache_key(sharding))
+    fn = _compiled(specs, batch.shape, dyn_key, _sharding_cache_key(sharding),
+                   _device_cache_key(None if sharding is not None else device))
     y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
     return y
 

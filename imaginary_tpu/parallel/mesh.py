@@ -78,6 +78,29 @@ def get_mesh(n_devices: Optional[int] = None, spatial: int = 1,
     return Mesh(grid, ("batch", "spatial"))
 
 
+def healthy_mesh(mesh: Mesh, healthy) -> Optional[Mesh]:
+    """A degraded view of `mesh` containing only the devices whose FLAT
+    index (the fault-domain index engine/devhealth.py tracks) is in
+    `healthy` — how sharded dispatch excludes quarantined chips so losing
+    one chip costs capacity, not availability.
+
+    The surviving devices re-form as a batch-only (n, 1) mesh: spatial
+    W-sharding needs the full, evenly-divisible grid, and a huge image
+    served from fewer chips beats a launch that fails on a dead one.
+    Returns None when nothing is healthy (the breaker's host-failover
+    path owns a total outage). A full healthy set returns `mesh` itself,
+    so the common case builds nothing."""
+    healthy = set(healthy)
+    flat = list(mesh.devices.flat)
+    if len(healthy) >= len(flat) and all(i in healthy for i in range(len(flat))):
+        return mesh
+    devs = [d for i, d in enumerate(flat) if i in healthy]
+    if not devs:
+        return None
+    grid = np.array(devs).reshape(len(devs), 1)
+    return Mesh(grid, ("batch", "spatial"))
+
+
 def mesh_devices(mesh: Mesh) -> int:
     return int(np.prod(mesh.devices.shape))
 

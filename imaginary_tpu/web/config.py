@@ -98,6 +98,14 @@ class ServerOptions:
     # Pin every host-executable plan to the host interpreter (measurement
     # override for bench_latency's host-path rows; see ExecutorConfig).
     force_host: bool = False
+    # Hedged failover dispatch (ExecutorConfig.hedge_threshold_ms): after
+    # this many ms stuck on the device path, launch a host-path twin and
+    # take the first success. 0 = OFF (the parity default — the submit
+    # path is byte-identical to the unhedged build). The budget caps
+    # concurrent hedges as a fraction of in-flight device items so
+    # hedging can never amplify an overload.
+    hedge_threshold_ms: float = 0.0
+    hedge_budget: float = 0.05
     prewarm: bool = False
     # --- content-addressed caching (imaginary_tpu/cache.py) ------------------
     # All tiers default OFF: with every knob at 0/False the serving path is

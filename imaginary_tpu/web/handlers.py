@@ -106,6 +106,8 @@ class ImageService:
                 spatial_threshold_px=o.spatial_threshold_px,
                 host_spill=o.host_spill,
                 force_host=o.force_host,
+                hedge_threshold_ms=o.hedge_threshold_ms,
+                hedge_budget=o.hedge_budget,
                 qos=qos,
             )
         )
@@ -162,6 +164,8 @@ class ImageService:
             except failpoints.FailpointError:
                 if qos is not None:
                     qos.stats.note_shed(kidx)
+                if tr is not None:
+                    tr.annotate(placement_attempts=["shed_503"])
                 raise new_error(
                     "Request shed by admission control, retry later", 503,
                     headers={"Retry-After": "1"}) from None
@@ -184,6 +188,10 @@ class ImageService:
                 # well-behaved clients back off instead of hammering.
                 if qos is not None:
                     qos.stats.note_shed(kidx)
+                if tr is not None:
+                    # the placement ladder's final rung: no capacity
+                    # anywhere, the request was shed before any work
+                    tr.annotate(placement_attempts=["shed_503"])
                 raise new_error(
                     "Server queue is full, retry later", 503,
                     headers={"Retry-After": _retry_after_s(est_ms)})
@@ -199,6 +207,8 @@ class ImageService:
                 if est_ms > rem * 1000.0:
                     if qos is not None:
                         qos.stats.note_shed(kidx)
+                    if tr is not None:
+                        tr.annotate(placement_attempts=["shed_503"])
                     raise new_error(
                         "Server queue exceeds request deadline, retry later",
                         503, headers={"Retry-After": _retry_after_s(est_ms)})
@@ -489,10 +499,18 @@ class ImageService:
             raise dl.error("device_queue")
         fut = self.executor.submit(arr, plan)
         try:
-            return fut.result(timeout=rem)
+            out = fut.result(timeout=rem)
         except FuturesTimeout:
             fut.cancel()  # queued: skipped at dispatch; running: result dropped
             raise dl.error("device_execute") from None
+        hp = getattr(fut, "_hedge_placement", None)
+        if hp:
+            # a hedge twin beat the device path: these pixels came from
+            # the host interpreter (X-Imaginary-Backend must say so)
+            from imaginary_tpu.engine.executor import note_placement
+
+            note_placement(hp)
+        return out
 
 
 # --- simple controllers -------------------------------------------------------
@@ -521,6 +539,11 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
 
 
 async def health_controller(request: web.Request, service: Optional[ImageService]) -> web.Response:
+    # chaos site, deliberately SYNCHRONOUS: a delay() armed here blocks
+    # the whole event loop — the "process alive, loop wedged" failure the
+    # workers.py supervisor's liveness probe exists to catch (an async
+    # sleep would only slow this one request and prove nothing)
+    failpoints.hit("worker.hang")
     return web.json_response(collect_health_stats(service))
 
 

@@ -54,6 +54,13 @@ def get_health_stats(executor=None, qos=None) -> dict:
         stats["backend"] = "unavailable"
     if executor is not None:
         stats["executor"] = executor.stats.to_dict()
+        # per-device fault domains (engine/devhealth.py): state, breaker
+        # counters, error/latency EWMAs, probe/readmission history for
+        # every chip — one quarantined device must be visible here long
+        # before it becomes a fleet-wide outage. /metrics renders the
+        # same block as imaginary_tpu_device_state so the two surfaces
+        # cannot drift.
+        stats["deviceHealth"] = executor.devhealth.snapshot()
     if qos is not None:
         # per-class qos counters + live queue depths (qos/shed.py
         # QosStats); /metrics renders the same block as

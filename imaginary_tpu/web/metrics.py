@@ -75,12 +75,22 @@ def render_metrics(stats: dict) -> str:
     stage_ms: list = []
     stage_total: list = []
     qos_classes: dict = {}
+    hedge_outcomes: dict = {}
+    device_health: dict = {}
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
             for k, v in value.items():
+                if k == "hedges" and isinstance(v, dict):
+                    # deferred: one labeled family
+                    # (imaginary_tpu_hedges_total{outcome=}) instead of
+                    # five scalar ones
+                    hedge_outcomes = v
+                    continue
                 mtype = "gauge" if k in _EXEC_GAUGES else "counter"
                 x.emit(f"imaginary_tpu_executor_{_snake(k)}", v, mtype=mtype,
                        help_text=f"Executor {k.replace('_', ' ')} (see /health).")
+        elif key == "deviceHealth" and isinstance(value, dict):
+            device_health = value
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
@@ -131,6 +141,36 @@ def render_metrics(stats: dict) -> str:
                    f'class="{escape_label_value(cls)}"',
                    mtype="gauge" if metric == "queued" else "counter",
                    help_text=help_text)
+    # launched is the sum of the outcomes-in-flight; exposing it inside
+    # the outcome family would double-count on sum(rate()) — and it must
+    # emit OUTSIDE the loop so the outcome family's samples stay
+    # contiguous (strict-exposition grouping)
+    if "launched" in hedge_outcomes:
+        x.emit("imaginary_tpu_hedges_launched_total",
+               hedge_outcomes["launched"], mtype="counter",
+               help_text="Speculative host-path hedge twins started.")
+    for outcome, v in sorted(hedge_outcomes.items()):
+        if outcome == "launched":
+            continue
+        x.emit("imaginary_tpu_hedges_total", v,
+               f'outcome="{escape_label_value(outcome)}"', mtype="counter",
+               help_text="Hedged failover dispatches by outcome "
+                         "(won|lost|failed|skipped_budget).")
+    if device_health:
+        x.emit("imaginary_tpu_devices_healthy", device_health.get("healthy", 0),
+               help_text="Dispatchable devices in the healthy state.")
+        x.emit("imaginary_tpu_devices_quarantined",
+               device_health.get("quarantined", 0),
+               help_text="Devices removed from the dispatchable set by "
+                         "their per-device breaker.")
+        for d in device_health.get("per_device", ()):
+            x.emit(
+                "imaginary_tpu_device_state", 1,
+                f'device="{d.get("device", "")}",'
+                f'state="{escape_label_value(str(d.get("state", "")))}"',
+                help_text="Per-device fault-domain state "
+                          "(healthy|quarantined|half_open); value is "
+                          "always 1.")
     for labels, v in stage_total:
         x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
                help_text="Samples recorded per pipeline stage.")

@@ -19,8 +19,27 @@ chip topology is a deployment concern).
 The supervisor is the parent process: it spawns workers as fresh
 interpreters (never fork-after-jax-init — the runtime owns threads a
 fork would orphan), forwards SIGTERM/SIGINT so every worker runs its own
-graceful 5 s drain, and respawns a worker that dies unexpectedly, with a
-restart budget so a boot-crash loop terminates instead of spinning.
+graceful 5 s drain, and supervises LIVENESS, not just exit status:
+
+  * crash: an exited worker respawns under a rolling-hour budget with
+    EXPONENTIAL BACKOFF (a boot-crash loop must converge to slow
+    retries, not spin at one jax-import per iteration);
+  * hang: a worker whose process is alive but whose event loop is
+    wedged (stuck accelerator runtime, blocked loop — the failure
+    `worker.hang=delay(...)` injects) never exits on its own. A probe
+    thread samples the fleet's shared /health port with a per-request
+    deadline and tracks when each worker index was last seen; a worker
+    unseen past the liveness window is declared hung. Its REPLACEMENT
+    spawns first — SO_REUSEPORT lets both bind, so new connections land
+    on a live listener while the old worker is torn down — then the
+    hung worker gets SIGTERM, a drain grace, and finally SIGKILL.
+
+Probe-by-sampling is the honest design for SO_REUSEPORT: all workers
+share one port, so no probe can TARGET worker k — but every /health
+response carries its worker index, the kernel spreads fresh connections
+across listeners, and the probe rate scales with the fleet size so a
+healthy worker going unseen for the whole window is vanishingly
+unlikely while a hung worker is unseen by construction.
 """
 
 from __future__ import annotations
@@ -29,6 +48,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 # env contract with cli.main: presence of WORKER_ENV marks a child (it
@@ -38,8 +58,15 @@ WORKER_ENV = "IMAGINARY_TPU_WORKER"
 
 # A worker that dies gets this many respawns per rolling hour before the
 # supervisor gives up and shuts the fleet down (a crash loop at boot
-# would otherwise spin forever at one jax-import per iteration).
+# would otherwise spin forever — the backoff slows it, the budget ends it).
 MAX_RESTARTS_PER_WORKER = 5
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def worker_index() -> int:
@@ -63,16 +90,108 @@ def _spawn(argv: list, idx: int) -> subprocess.Popen:
                             env=env)
 
 
-def run_supervisor(argv: list, workers: int) -> int:
+class _LivenessProbe:
+    """Samples the fleet's shared /health port from a daemon thread and
+    records, per worker index, when that worker last answered. The probe
+    carries its own per-request deadline so a hung worker costs one
+    timed-out sample, never a wedged prober."""
+
+    def __init__(self, health_url: str, workers: int, interval_s: float,
+                 timeout_s: float):
+        self.health_url = health_url
+        self.last_seen: dict = {}
+        self._lock = threading.Lock()
+        # more workers need more samples for the same per-worker coverage
+        self._interval = max(0.2, interval_s / max(1, workers))
+        self._timeout = timeout_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="itpu-supervisor-probe")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import ssl
+
+        ctx = None
+        if self.health_url.startswith("https:"):
+            # a self-signed serving cert must not blind the prober
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        # Samples run CONCURRENTLY, one short-lived thread each: a hung
+        # worker's listener keeps accepting (the backlog answers the
+        # handshake, the wedged loop never answers the request), so a
+        # serial prober would spend most of its life stalled on the very
+        # worker it is trying to convict — and every HEALTHY worker would
+        # go "unseen" too, cascading into false hang kills (measured:
+        # one SIGSTOPped worker took the whole fleet's liveness down).
+        inflight = threading.Semaphore(16)
+        while not self._stop.wait(self._interval):
+            if not inflight.acquire(blocking=False):
+                continue  # stalled samples already saturate the cap
+            threading.Thread(target=self._sample_once,
+                             args=(ctx, inflight), daemon=True,
+                             name="itpu-supervisor-sample").start()
+
+    def _sample_once(self, ctx, inflight) -> None:
+        import json
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                self.health_url, headers={"Connection": "close"})
+            with urllib.request.urlopen(
+                    req, timeout=self._timeout, context=ctx) as r:
+                body = json.loads(r.read())
+            idx = int(body.get("worker", -1))
+        except Exception:
+            return  # timeouts/refusals are absence, not evidence
+        finally:
+            inflight.release()
+        if idx >= 0:
+            with self._lock:
+                self.last_seen[idx] = time.monotonic()
+
+    def seen_at(self, idx: int):
+        with self._lock:
+            return self.last_seen.get(idx)
+
+    def forget(self, idx: int) -> None:
+        """A respawned worker starts a fresh liveness clock."""
+        with self._lock:
+            self.last_seen.pop(idx, None)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
     """Spawn and babysit `workers` serving processes; returns an exit code.
 
     Lifecycle: SIGTERM/SIGINT here fans out to every worker (each drains
     in-flight requests, ref: server.go:144-165 semantics per process);
     the supervisor then waits for all of them. An unexpected worker death
-    outside shutdown is respawned under the restart budget.
+    outside shutdown is respawned under the restart budget with
+    exponential backoff; with a `health_url`, a HUNG worker (alive but
+    unseen by the liveness probe past the window) is replaced
+    drain-aware: spawn the replacement, then SIGTERM -> grace -> SIGKILL
+    the hung one.
     """
+    probe_interval = _env_f("IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL", 2.0)
+    probe_timeout = _env_f("IMAGINARY_TPU_SUPERVISOR_PROBE_TIMEOUT", 2.0)
+    # 0 disables hang detection (probing still runs for logs/ops)
+    liveness_timeout = _env_f("IMAGINARY_TPU_SUPERVISOR_LIVENESS_TIMEOUT", 30.0)
+    # a fresh worker pays a jax import + backend init before it can answer
+    boot_grace = _env_f("IMAGINARY_TPU_SUPERVISOR_BOOT_GRACE", 90.0)
+    hang_grace = _env_f("IMAGINARY_TPU_SUPERVISOR_HANG_GRACE", 7.0)
+    backoff_base = _env_f("IMAGINARY_TPU_SUPERVISOR_BACKOFF", 0.5)
+
     procs: dict = {}
+    spawn_t: dict = {}
     restarts = {i: [] for i in range(workers)}
+    consec_restarts = {i: 0 for i in range(workers)}
+    respawn_at: dict = {}  # idx -> monotonic time the backoff allows it
+    terminating: list = []  # (proc, sigkill_deadline) for hung workers
     stopping = False
 
     def handle_stop(signum, frame):
@@ -84,8 +203,27 @@ def run_supervisor(argv: list, workers: int) -> int:
 
     for i in range(workers):
         procs[i] = _spawn(argv, i)
+        spawn_t[i] = time.monotonic()
     print(f"imaginary-tpu supervisor: {workers} workers "
           f"(pids {[p.pid for p in procs.values()]})")
+
+    probe = None
+    if health_url and liveness_timeout > 0:
+        probe = _LivenessProbe(health_url, workers, probe_interval,
+                               probe_timeout)
+
+    def charge_restart(i: int, now: float) -> bool:
+        """Book one restart against worker i's budget; False = exhausted."""
+        restarts[i] = [t for t in restarts[i] if now - t < 3600.0]
+        if len(restarts[i]) >= MAX_RESTARTS_PER_WORKER:
+            return False
+        restarts[i].append(now)
+        # survived long enough since its last (re)spawn? the crash loop
+        # is over — start the backoff ladder from the bottom again
+        if now - spawn_t.get(i, 0.0) > 60.0:
+            consec_restarts[i] = 0
+        consec_restarts[i] += 1
+        return True
 
     exit_code = 0
     stop_deadline = None
@@ -103,6 +241,7 @@ def run_supervisor(argv: list, workers: int) -> int:
             if stop_deadline is None:
                 stop_deadline = time.monotonic() + 15.0  # 5 s drain + margin
             alive = [p for p in procs.values() if p.poll() is None]
+            alive += [p for p, _ in terminating if p.poll() is None]
             if not alive:
                 break
             hard = time.monotonic() > stop_deadline
@@ -113,29 +252,84 @@ def run_supervisor(argv: list, workers: int) -> int:
                     pass
             time.sleep(0.1)
             continue
+        now = time.monotonic()
+        # escalate hung workers being drained: SIGTERM was sent when the
+        # replacement spawned; past the grace the kernel takes over
+        for p, deadline in list(terminating):
+            if p.poll() is not None:
+                terminating.remove((p, deadline))
+            elif now > deadline:
+                try:
+                    p.kill()
+                except ProcessLookupError:
+                    pass
         # Sweep deaths BEFORE any liveness break: if every worker dies
         # inside one interval (shared boot crash — bad mount, bad cert),
         # the respawn/budget logic must still run; breaking on "none
         # alive" first would report exit 0 for a fleet that never served.
         for i, p in list(procs.items()):
             rc = p.poll()
-            if rc is None or stopping:
+            if stopping:
                 continue
-            now = time.monotonic()
-            restarts[i] = [t for t in restarts[i] if now - t < 3600.0]
-            if len(restarts[i]) >= MAX_RESTARTS_PER_WORKER:
-                print(f"imaginary-tpu supervisor: worker {i} exceeded the "
-                      "restart budget; shutting down", file=sys.stderr)
-                exit_code = rc or 1
-                stopping = True
-                break
-            restarts[i].append(now)
-            print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
-                  f"exited {rc}; respawning", file=sys.stderr)
-            procs[i] = _spawn(argv, i)
+            if rc is None:
+                # alive — but is it SERVING? A worker the probe has not
+                # seen for the whole liveness window (measured from its
+                # last sighting, or from spawn + boot grace) is hung:
+                # replace it drain-aware, then terminate it.
+                if probe is None:
+                    continue
+                seen = probe.seen_at(i)
+                ref = seen if seen is not None else spawn_t[i] + boot_grace
+                if now - ref < liveness_timeout:
+                    continue
+                if not charge_restart(i, now):
+                    print(f"imaginary-tpu supervisor: worker {i} hung and "
+                          "exceeded the restart budget; shutting down",
+                          file=sys.stderr)
+                    exit_code = 1
+                    stopping = True
+                    break
+                print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
+                      f"unseen for {now - ref:.0f}s; presumed hung — "
+                      "spawning replacement, then SIGTERM",
+                      file=sys.stderr)
+                # replacement FIRST: both bind via SO_REUSEPORT, so the
+                # port keeps a live listener while the old worker drains
+                probe.forget(i)
+                procs[i] = _spawn(argv, i)
+                spawn_t[i] = now
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+                terminating.append((p, now + hang_grace))
+                continue
+            # exited: respawn under budget, after the backoff delay
+            if i not in respawn_at:
+                if not charge_restart(i, now):
+                    print(f"imaginary-tpu supervisor: worker {i} exceeded "
+                          "the restart budget; shutting down",
+                          file=sys.stderr)
+                    exit_code = rc or 1
+                    stopping = True
+                    break
+                delay = min(30.0, backoff_base
+                            * (2.0 ** (consec_restarts[i] - 1)))
+                respawn_at[i] = now + delay
+                print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
+                      f"exited {rc}; respawning in {delay:.1f}s",
+                      file=sys.stderr)
+            if now >= respawn_at[i]:
+                respawn_at.pop(i, None)
+                if probe is not None:
+                    probe.forget(i)
+                procs[i] = _spawn(argv, i)
+                spawn_t[i] = now
         time.sleep(0.2)
 
-    for p in procs.values():  # reap
+    if probe is not None:
+        probe.close()
+    for p in list(procs.values()) + [p for p, _ in terminating]:  # reap
         try:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:

@@ -323,9 +323,32 @@ class TestResultCacheHTTP:
         run(ServerOptions(cache_result_mb=8.0, mount=FIXTURES), fn)
 
     def test_eviction_under_byte_budget_http(self):
+        # Deterministic byte accounting, two passes. The old version
+        # hardcoded a 0.006 MB budget against "~3-6 KB" bodies, which
+        # flaked under host load: the cost model flips placement (device
+        # vs host SIMD) with load, the two backends' pixels are
+        # PSNR-equivalent but not bit-identical, and the encoded sizes
+        # moved across the magic budget. force_host pins placement (so
+        # bodies are the same bytes every run), pass 1 MEASURES them,
+        # and pass 2 sets the budget from the measurement: large enough
+        # for any single body, too small for any two.
+        sizes: dict = {}
+
+        async def measure(client, _origin, app):
+            for w in (100, 110, 120):
+                res = await client.post(f"/resize?width={w}&height=70",
+                                        data=jpg())
+                assert res.status == 200
+                sizes[w] = len(await res.read())
+
+        run(ServerOptions(force_host=True), measure)
+        ordered = sorted(sizes.values())
+        budget_bytes = ordered[0] + ordered[1] - 1  # any one fits, no two do
+        assert budget_bytes >= max(ordered)
+
         async def fn(client, _origin, app):
-            # budget sized to hold roughly one encoded result: distinct
-            # requests must evict each other and re-miss
+            # at most one entry ever resident: every request must miss
+            # and evict its predecessor
             for w in (100, 110, 120, 100, 110, 120):
                 res = await client.post(f"/resize?width={w}&height=70",
                                         data=jpg())
@@ -335,8 +358,8 @@ class TestResultCacheHTTP:
             assert st.result_hits == 0
             assert st.result_misses == 6
 
-        # ~3-6 KB per body; 0.006 MB keeps at most one or two
-        run(ServerOptions(cache_result_mb=0.006), fn)
+        run(ServerOptions(cache_result_mb=budget_bytes / 1e6,
+                          force_host=True), fn)
 
     def test_accept_negotiation_keys_separately(self):
         async def fn(client, _origin, app):
