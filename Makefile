@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos chaos serve clean gate lint
+.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory chaos serve clean gate lint
 
 all: native test
 
@@ -21,18 +21,23 @@ gate: lint test chaos
 	  { echo "bench_deadline.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=2 BENCH_CONCURRENCY=8 python bench_qos.py || \
 	  { echo "bench_qos.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline/qos benches all pass"
+	BENCH_DURATION=4 BENCH_CONCURRENCY=6 python bench_memory.py || \
+	  { echo "bench_memory.py failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory benches all pass"
 
-# Chaos drill (ISSUE 4 + ISSUE 6): the deadline/failpoint/devhealth
-# suites, then two soaks — a flaky-origin row (source.fetch=error(0.2):
-# availability >= 95%, honest 502/503/504 mapping, deadline boundedness,
-# ledgers at rest) and a chip-loss row (device.chip_error on the primary
-# device mid-run: failover keeps serving, the sick chip quarantines
-# alone, the probe re-admits it after its cooldown). The two forced CPU
-# devices make the multi-chip fault-domain path run on hardware-less CI;
-# real multi-chip hosts exercise it natively.
+# Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7): the deadline/failpoint/
+# devhealth/pressure suites, then four soaks — a flaky-origin row
+# (source.fetch=error(0.2): availability >= 95%, honest 502/503/504
+# mapping, deadline boundedness, ledgers at rest), a chip-loss row
+# (device.chip_error on the primary device mid-run: failover keeps
+# serving, the sick chip quarantines alone, the probe re-admits it after
+# its cooldown), a hedge A-B row, and an OOM-storm row (device.oom at
+# p=0.5: every request completes via bisect-retry or host routing, the
+# breaker never opens, ledgers at rest). The two forced CPU devices make
+# the multi-chip fault-domain path run on hardware-less CI; real
+# multi-chip hosts exercise it natively.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py -q
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py -q -m 'not slow'
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
 	  JAX_PLATFORMS=cpu python bench_chaos.py || \
@@ -82,6 +87,13 @@ bench-deadline:
 # to improve the interactive p99 or breaches the isolation bound
 bench-qos:
 	python bench_qos.py
+
+# bomb + oversize-enlarge firehose, governor on vs off: the governed arm
+# must hold >=95% well-formed availability (only 200/413/503/504) with
+# peak RSS under the configured ceiling; the ungoverned arm must exceed
+# that ceiling (BENCH_RSS_CEILING_MB tunes it)
+bench-memory:
+	python bench_memory.py
 
 docker:
 	docker build -t imaginary-tpu .

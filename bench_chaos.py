@@ -28,6 +28,14 @@ holds availability. Invariants: >= 95% 2xx, zero 5xx storm (500s == 0,
 errors only from the breaker's pre-trip window), /health shows the
 quarantine, and the device is HEALTHY again after re-admission.
 
+ROW 4 — OOM storm (ISSUE 7): `device.oom=error(0.5)` makes half of all
+device launches — including every bisect-retry level — read as
+RESOURCE_EXHAUSTED, with host_spill off so everything actually rides the
+device path. Invariants: every request completes (>= 95% 2xx, zero raw
+5xx) via bisect-retry or host routing, the recovery counters show real
+splits AND host routings, the breaker NEVER opens (OOM is capacity, not
+fault), and the owed-work ledgers are at rest afterward.
+
 Prints one JSON line per row on stdout; human detail on stderr; nonzero
 exit on any violated invariant.
 """
@@ -338,6 +346,116 @@ def _hedge_row(duration: float, concurrency: int) -> int:
     return 0
 
 
+async def _oom_storm_soak(duration: float, concurrency: int) -> dict:
+    from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    # host_spill OFF pins traffic to the device path so the storm hits
+    # real launches (recovery's HOST ROUTING is independent of the spill
+    # policy and still engages for items that OOM at the bisect floor)
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, request_timeout_s=10.0, host_spill=False))
+    ex = app["service"].executor
+    counts: dict = {}
+    try:
+        failpoints.activate("device.oom=error(0.5)")
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        deadline = time.monotonic() + duration
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def worker():
+                while time.monotonic() < deadline:
+                    try:
+                        async with session.get(next(urls)) as res:
+                            await res.read()
+                            counts[res.status] = counts.get(res.status, 0) + 1
+                    except Exception:
+                        counts["exc"] = counts.get("exc", 0) + 1
+
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+        failpoints.deactivate()
+        # rest-state: every owed-work charge released
+        at_rest = False
+        for _ in range(100):
+            with ex._owed_lock:
+                at_rest = (ex._device_items == 0
+                           and abs(ex._device_owed_mb) < 1e-6)
+            if at_rest:
+                break
+            await asyncio.sleep(0.02)
+    finally:
+        failpoints.deactivate()
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"counts": counts, "at_rest": at_rest,
+            "oom_events": ex.stats.oom_events,
+            "oom_splits": ex.stats.oom_splits,
+            "oom_host_routed": ex.stats.oom_host_routed,
+            "oom_failed": ex.stats.oom_failed,
+            "breaker_opens": ex.stats.breaker_opens,
+            "device_oom_records": ex.devhealth.record(0).oom_events}
+
+
+def _oom_storm_row(duration: float, concurrency: int) -> int:
+    got = asyncio.run(_oom_storm_soak(duration, concurrency))
+    counts = got["counts"]
+    total = sum(counts.values())
+    ok = counts.get(200, 0)
+    raw_5xx = sum(v for k, v in counts.items()
+                  if isinstance(k, int) and 500 <= k < 600
+                  and k not in (503, 504))
+    row = {
+        "metric": "chaos_oom_storm",
+        "requests": total,
+        "ok": ok,
+        "ok_ratio": round(ok / total, 4) if total else 0.0,
+        "oom_events": got["oom_events"],
+        "oom_splits": got["oom_splits"],
+        "oom_host_routed": got["oom_host_routed"],
+        "oom_failed": got["oom_failed"],
+        "breaker_opens": got["breaker_opens"],
+        "ledgers_at_rest": got["at_rest"],
+        "counts": {str(k): v for k, v in sorted(counts.items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if total == 0:
+        fails.append("OOM storm produced zero requests")
+    if total and ok / total < 0.95:
+        fails.append(f"availability {ok}/{total} below 95% under OOM storm")
+    if raw_5xx:
+        fails.append(f"{raw_5xx} raw 5xx responses under OOM storm")
+    if got["oom_events"] == 0:
+        fails.append("storm fired but no OOM recovery ever ran")
+    if got["oom_splits"] == 0 and got["oom_host_routed"] == 0:
+        fails.append("recovery booked neither splits nor host routings")
+    if got["breaker_opens"]:
+        fails.append(f"OOM tripped the breaker {got['breaker_opens']}x "
+                     "(capacity must never read as fault)")
+    if not got["at_rest"]:
+        fails.append("owed-work ledgers not at rest after the storm")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[chaos] PASS (OOM storm): {ok}/{total} ok via "
+          f"{got['oom_splits']} splits + {got['oom_host_routed']} host "
+          f"routings across {got['oom_events']} OOM events, breaker "
+          "closed, ledgers at rest", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -401,7 +519,11 @@ def main() -> int:
     if rc:
         return rc
     # ROW 3: hedged failover vs a 250 ms-delayed device, A-B
-    return _hedge_row(duration, concurrency)
+    rc = _hedge_row(duration, concurrency)
+    if rc:
+        return rc
+    # ROW 4: OOM storm — bisect-retry + host routing keep availability
+    return _oom_storm_row(max(duration / 2, 2.0), concurrency)
 
 
 if __name__ == "__main__":

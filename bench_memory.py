@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Memory-pressure firehose (`make bench-memory`, wired into `make gate`).
+
+One A-B row proving the ISSUE 7 acceptance shape: a bomb + oversize-
+enlarge firehose against an in-process server, governor ON vs OFF.
+
+  * ON arm (first, so the OFF arm's RSS growth cannot contaminate its
+    measurement): --max-allowed-resolution 18 and the pressure governor
+    armed with its RSS ceiling AT the current baseline — the honest
+    worst case, "the operator's ceiling is where we already are", so the
+    ladder is critical from the first sample. Invariants: availability
+    (well-formed responses) >= 95%, statuses ONLY in {200, 413, 503,
+    504} with real 200s among them, ZERO raw 5xx / exceptions / process
+    deaths, and peak RSS under baseline + BENCH_RSS_CEILING_MB.
+  * OFF arm: every guard off (--max-allowed-resolution 0, no governor).
+    The same firehose decodes the bombs' declared frames and
+    materializes the oversize outputs; peak RSS must EXCEED the ceiling
+    the governed arm held — that gap is the subsystem's reason to exist.
+
+Bombs are structurally valid PNG headers declaring ~100-megapixel frames
+over one token row of data (the decompression-bomb shape); enlarges ask
+a 1080p source for a 33 MP output. Peak RSS is sampled from
+/proc/self/status every 25 ms by a background task.
+
+Prints one JSON line on stdout; human detail on stderr; nonzero exit on
+any violated invariant.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import struct
+import sys
+import time
+import zlib
+
+
+def _png_bomb(w: int = 10000, h: int = 10000) -> bytes:
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        body = tag + payload
+        return (struct.pack(">I", len(payload)) + body
+                + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF))
+
+    return (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0))
+            + chunk(b"IDAT", zlib.compress(b"\x00" * (w * 3 + 1)))
+            + chunk(b"IEND", b""))
+
+
+async def _rss_sampler(peak: list, stop: asyncio.Event) -> None:
+    from imaginary_tpu.web.health import _rss_mb
+
+    while not stop.is_set():
+        peak[0] = max(peak[0], _rss_mb())
+        await asyncio.sleep(0.025)
+
+
+async def _arm(options, duration: float, concurrency: int,
+               origin_base: str, base: str) -> dict:
+    import aiohttp
+
+    counts: dict = {}
+    peak = [0.0]
+    stop = asyncio.Event()
+    sampler = asyncio.create_task(_rss_sampler(peak, stop))
+    # the firehose mix: 1 bomb : 1 oversize enlarge : 2 modest resizes
+    urls = itertools.cycle([
+        f"{base}/resize?width=100&height=100&url={origin_base}/bomb.png",
+        f"{base}/enlarge?width=7680&height=4320&url={origin_base}/img.jpg",
+        f"{base}/resize?width=300&height=200&url={origin_base}/img.jpg",
+        f"{base}/resize?width=320&height=240&url={origin_base}/img.jpg",
+    ])
+    deadline = time.monotonic() + duration
+    conn = aiohttp.TCPConnector(limit=0)
+    try:
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def worker():
+                while time.monotonic() < deadline:
+                    try:
+                        async with session.get(next(urls)) as res:
+                            await res.read()
+                            counts[res.status] = counts.get(res.status, 0) + 1
+                    except Exception:
+                        counts["exc"] = counts.get("exc", 0) + 1
+
+            await asyncio.gather(*[worker() for _ in range(concurrency)])
+    finally:
+        stop.set()
+        await sampler
+    return {"counts": counts, "peak_rss_mb": peak[0]}
+
+
+async def _run(duration: float, concurrency: int, ceiling_add_mb: float) -> dict:
+    from aiohttp import web
+
+    from bench_cache import _start_server
+    from bench_util import free_port, make_1080p_jpeg
+    from imaginary_tpu.web.config import ServerOptions
+    from imaginary_tpu.web.health import _rss_mb
+
+    # origin serving the bomb and the enlarge source
+    bomb = _png_bomb()
+    jpeg = make_1080p_jpeg()
+
+    async def origin_handler(request):
+        if request.path.endswith("bomb.png"):
+            return web.Response(body=bomb, content_type="image/png")
+        return web.Response(body=jpeg, content_type="image/jpeg")
+
+    oapp = web.Application()
+    oapp.router.add_get("/{tail:.*}", origin_handler)
+    orunner = web.AppRunner(oapp, access_log=None)
+    await orunner.setup()
+    oport = free_port()
+    await web.TCPSite(orunner, "127.0.0.1", oport).start()
+    origin_base = f"http://127.0.0.1:{oport}"
+
+    try:
+        # decode one small source + touch the executor once so the
+        # baseline includes runtime init (jax, codec backends), not the
+        # firehose's fault
+        from imaginary_tpu import codecs
+
+        codecs.decode(jpeg)
+        baseline = _rss_mb()
+        ceiling = baseline + ceiling_add_mb
+
+        # --- ON arm first: its peak must not be polluted by OFF's growth
+        on_runner, on_app, on_base = await _start_server(ServerOptions(
+            enable_url_source=True, request_timeout_s=10.0,
+            max_allowed_pixels=18.0,
+            pressure_rss_mb=max(baseline, 1.0)))
+        try:
+            on = await _arm(None, duration, concurrency, origin_base, on_base)
+            on["pressure"] = on_app["service"].pressure.snapshot()
+        finally:
+            await on_runner.cleanup()
+
+        # --- OFF arm: every guard off, same firehose
+        off_runner, off_app, off_base = await _start_server(ServerOptions(
+            enable_url_source=True, request_timeout_s=30.0,
+            max_allowed_pixels=0.0))
+        try:
+            off = await _arm(None, duration, concurrency, origin_base,
+                             off_base)
+        finally:
+            await off_runner.cleanup()
+    finally:
+        await orunner.cleanup()
+    return {"baseline_rss_mb": baseline, "ceiling_mb": ceiling,
+            "on": on, "off": off}
+
+
+def main() -> int:
+    from bench_util import ensure_native_built
+
+    ensure_native_built()
+    duration = float(os.environ.get("BENCH_DURATION", "6")) / 2.0
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8"))
+    ceiling_add = float(os.environ.get("BENCH_RSS_CEILING_MB", "192"))
+
+    print(f"[memory] firehose: {concurrency} clients x {duration:.1f}s/arm, "
+          f"ceiling = baseline + {ceiling_add:.0f} MB", file=sys.stderr)
+    got = asyncio.run(_run(duration, concurrency, ceiling_add))
+
+    on, off = got["on"], got["off"]
+    ceiling = got["ceiling_mb"]
+    on_counts = on["counts"]
+    on_total = sum(on_counts.values())
+    allowed = sum(on_counts.get(s, 0) for s in (200, 413, 503, 504))
+    row = {
+        "metric": "memory_firehose",
+        "baseline_rss_mb": round(got["baseline_rss_mb"], 1),
+        "rss_ceiling_mb": round(ceiling, 1),
+        "peak_rss_mb_governor_on": round(on["peak_rss_mb"], 1),
+        "peak_rss_mb_governor_off": round(off["peak_rss_mb"], 1),
+        "requests_on": on_total,
+        "ok_on": on_counts.get(200, 0),
+        "availability_on": round(allowed / on_total, 4) if on_total else 0.0,
+        "pressure_level_end": on["pressure"]["level"],
+        "pixel_clamps": on["pressure"]["pixel_clamps"],
+        "counts_on": {str(k): v for k, v in sorted(on_counts.items(), key=str)},
+        "counts_off": {str(k): v
+                       for k, v in sorted(off["counts"].items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if on_total == 0:
+        fails.append("governed arm produced zero requests")
+    if on_total and allowed / on_total < 0.95:
+        fails.append(f"availability {allowed}/{on_total} below 95% "
+                     "(well-formed 200/413/503/504)")
+    surprises = {k: v for k, v in on_counts.items()
+                 if k not in (200, 413, 503, 504)}
+    if surprises:
+        fails.append(f"governed arm statuses outside 200/413/503/504: "
+                     f"{surprises}")
+    if on_counts.get(200, 0) == 0:
+        fails.append("governed arm served zero 200s (clamp over-shed)")
+    if on["peak_rss_mb"] > ceiling:
+        fails.append(f"governed peak RSS {on['peak_rss_mb']:.0f} MB broke "
+                     f"the {ceiling:.0f} MB ceiling")
+    if off["peak_rss_mb"] <= ceiling:
+        fails.append(f"ungoverned peak RSS {off['peak_rss_mb']:.0f} MB never "
+                     f"exceeded the {ceiling:.0f} MB ceiling — the A-B "
+                     "proves nothing on this host/workload")
+    if fails:
+        for f in fails:
+            print(f"[memory] FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[memory] PASS: governed peak {on['peak_rss_mb']:.0f} MB <= "
+          f"ceiling {ceiling:.0f} MB < ungoverned peak "
+          f"{off['peak_rss_mb']:.0f} MB; availability "
+          f"{row['availability_on']:.1%}, {row['ok_on']} 200s, "
+          f"{row['pixel_clamps']} clamps, zero deaths", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

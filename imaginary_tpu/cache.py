@@ -62,6 +62,10 @@ class CacheStats:
     flight_executed: int = 0
     flight_coalesced: int = 0
     etag_304: int = 0
+    # brownout ladder (engine/pressure.py): times the tiers' budgets were
+    # shrunk by a pressure transition (restores don't count — the
+    # interesting fact is how often memory pressure took cache capacity)
+    pressure_shrinks: int = 0
 
 
 class ByteBudgetLRU:
@@ -120,6 +124,21 @@ class ByteBudgetLRU:
                 self._bytes -= old[1]
             self._map[key] = (value, size, expires)
             self._bytes += size
+            while self._bytes > self.budget and self._map:
+                _, (_, osize, _) = self._map.popitem(last=False)
+                self._bytes -= osize
+                evicted += 1
+        if evicted and self._on_evict is not None:
+            self._on_evict(evicted)
+
+    def set_budget(self, budget_bytes: int) -> None:
+        """Re-budget the tier live, evicting LRU-first down to the new
+        budget (the brownout ladder shrinks budgets at elevated pressure
+        and restores them at ok — eviction here must actually free the
+        bytes, not just move a limit)."""
+        evicted = 0
+        with self._lock:
+            self.budget = max(0, int(budget_bytes))
             while self._bytes > self.budget and self._map:
                 _, (_, osize, _) = self._map.popitem(last=False)
                 self._bytes -= osize
@@ -250,6 +269,39 @@ class CacheSet:
             ttl_s=source_ttl_s, on_evict=_ev("source_evictions"))
         self.coalesce = bool(coalesce)
         self.flight = Singleflight(stats=s)
+        # pristine budgets, restored when pressure recedes (the brownout
+        # ladder below mutates the live ones)
+        self._base_budgets = (self.result.budget, self.frames.budget,
+                              self.source.budget)
+        self._pressure_level = 0
+
+    def apply_pressure(self, level: int) -> None:
+        """Brownout rung for the cache tiers (engine/pressure.py wires
+        this as a governor transition callback). Elevated: result/frame
+        budgets halve — cache hits are cheap to re-earn, resident cache
+        bytes are exactly the RSS the governor is trying to reclaim.
+        Critical: quarter budgets and DISABLE the remote-source cache
+        (whole encoded bodies, the largest entries per hit). Level ok
+        restores the configured budgets; entries evicted under pressure
+        simply miss and re-fill."""
+        if level == self._pressure_level:
+            return
+        self._pressure_level = level
+        result_b, frame_b, source_b = self._base_budgets
+        if level >= 2:
+            self.result.set_budget(result_b // 4)
+            self.frames.set_budget(frame_b // 4)
+            self.source.set_budget(0)
+        elif level == 1:
+            self.result.set_budget(result_b // 2)
+            self.frames.set_budget(frame_b // 2)
+            self.source.set_budget(source_b)
+        else:
+            self.result.set_budget(result_b)
+            self.frames.set_budget(frame_b)
+            self.source.set_budget(source_b)
+        if level > 0:
+            self.stats.pressure_shrinks += 1
 
     @classmethod
     def from_options(cls, o) -> "CacheSet":
@@ -288,6 +340,7 @@ class CacheSet:
             "flight_executed": s.flight_executed,
             "flight_coalesced": s.flight_coalesced,
             "etag_304": s.etag_304,
+            "pressure_shrinks": s.pressure_shrinks,
         }
 
 

@@ -150,6 +150,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-attempt origin connect timeout in seconds")
     p.add_argument("--source-read-timeout", type=float, default=30.0,
                    help="per-attempt origin total read timeout in seconds")
+    # memory-pressure resilience (imaginary_tpu/engine/pressure.py):
+    # governor + brownout ladder + OOM bisect-retry; defaults OFF
+    # (--pressure-rss-mb 0 builds no governor — byte parity)
+    p.add_argument("--pressure-rss-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_RSS_MB", 0.0),
+                   help="RSS ceiling in MB for the memory-pressure "
+                        "governor: elevated at 75%%, critical at 90%% "
+                        "(see --pressure-*-frac); drives the brownout "
+                        "ladder (cache shrink, oversize-to-host, batch "
+                        "shed, pixel clamp); 0 disables the subsystem")
+    p.add_argument("--pressure-hbm-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_HBM_MB", 0.0),
+                   help="estimated device-HBM budget in MB (fed by the "
+                        "executor's per-batch wire-byte ledger); 0 skips "
+                        "the device signal")
+    p.add_argument("--pressure-elevated-frac", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_ELEVATED_FRAC",
+                                      0.75),
+                   help="fraction of a limit at which pressure reads "
+                        "'elevated'")
+    p.add_argument("--pressure-critical-frac", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_CRITICAL_FRAC",
+                                      0.90),
+                   help="fraction of a limit at which pressure reads "
+                        "'critical'")
+    p.add_argument("--pressure-batch-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_BATCH_MB", 32.0),
+                   help="admitted device-batch wire-MB cap under pressure "
+                        "(halved at critical); 0 never caps")
+    p.add_argument("--pressure-oversize-mpix", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_OVERSIZE_MPIX",
+                                      4.0),
+                   help="source megapixels at which batch-class work is "
+                        "forced to the host interpreter under elevated "
+                        "pressure")
+    p.add_argument("--pressure-pixel-frac", type=float,
+                   default=_env_float("IMAGINARY_TPU_PRESSURE_PIXEL_FRAC",
+                                      0.25),
+                   help="fraction of --max-allowed-resolution the critical "
+                        "rung's pixel-admission clamp allows (source and "
+                        "requested output dims)")
     # multi-tenant QoS (imaginary_tpu/qos/): tenant table + priority
     # classes + per-tenant rates/shares; defaults OFF (single default
     # tenant, FIFO executor intake, byte-identical responses)
@@ -326,6 +367,13 @@ def options_from_args(args) -> ServerOptions:
         source_connect_timeout_s=max(0.001, args.source_connect_timeout),
         source_read_timeout_s=max(0.001, args.source_read_timeout),
         qos_config=args.qos_config,
+        pressure_rss_mb=max(0.0, args.pressure_rss_mb),
+        pressure_hbm_mb=max(0.0, args.pressure_hbm_mb),
+        pressure_elevated_frac=min(1.0, max(0.01, args.pressure_elevated_frac)),
+        pressure_critical_frac=min(1.0, max(0.01, args.pressure_critical_frac)),
+        pressure_batch_mb=max(0.0, args.pressure_batch_mb),
+        pressure_oversize_mpix=max(0.0, args.pressure_oversize_mpix),
+        pressure_pixel_frac=min(1.0, max(0.01, args.pressure_pixel_frac)),
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         use_mesh=args.use_mesh,

@@ -15,11 +15,13 @@ processing pipeline unless NoAutoRotate is set; image.go:255-265).
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 from typing import Optional
 
 import numpy as np
 
+from imaginary_tpu import failpoints
 from imaginary_tpu.errors import ImageError
 from imaginary_tpu.imgtype import ImageType, determine_image_type
 
@@ -269,6 +271,7 @@ def yuv420_supported() -> bool:
 
 def decode_yuv420(buf: bytes, shrink: int, hb: int, wb: int):
     """Packed-layout 4:2:0 decode; see native_backend.decode_yuv420."""
+    _bomb_gate(buf, determine_image_type(buf))
     return _backend().decode_yuv420(buf, shrink, hb, wb)
 
 
@@ -331,6 +334,72 @@ def backend_name() -> str:
     return _backend().NAME
 
 
+# --- pre-decode bomb gate (memory-pressure subsystem) -------------------------
+#
+# A decompression bomb is a few hundred header bytes that DECLARE a
+# multi-gigabyte frame: the reference survives them because libvips
+# checks declared dimensions before allocating (demand-driven tiling +
+# the 18 MP cap at imaginary.go:36), while our backends materialize the
+# whole frame the header asks for. The gate below re-checks the cap that
+# web/handlers.py enforces — but at the LAST boundary before allocation,
+# on every backend, so a header the handler's probe couldn't parse (or a
+# caller that skipped the handler entirely: watermark fetches, direct
+# pipeline users) still cannot make decode() allocate past the cap. The
+# frame allocation itself is what this bounds — there is no other decode
+# scratch that scales past the declared output (strip/row buffers are
+# O(width)).
+#
+# The cap rides a ContextVar, not module state: the web layer stamps it
+# per request (copy_context carries it into pool threads exactly like
+# the trace/deadline vars), so concurrently-served options never race
+# and direct library users — tests, benches — keep the unbounded default
+# unless they opt in.
+
+_DECODE_PIXEL_CAP: contextvars.ContextVar = contextvars.ContextVar(
+    "itpu_decode_pixel_cap", default=0.0)
+
+
+def set_decode_pixel_cap(mpix: float):
+    """Arm the pre-decode dimension gate for the current context, in
+    megapixels (0 disarms). Returns the Token for callers that restore."""
+    return _DECODE_PIXEL_CAP.set(max(0.0, float(mpix)))
+
+
+def decode_pixel_cap() -> float:
+    return _DECODE_PIXEL_CAP.get()
+
+
+def _bomb_gate(buf: bytes, t: ImageType) -> None:
+    """Reject a decode whose DECLARED dimensions exceed the armed cap,
+    before any frame is allocated. 413: the request's payload demands
+    more memory than this server will commit (the handler's own guard
+    answers 422 for parity; by the time the codec-level gate fires the
+    pressure subsystem is armed and honesty-about-memory wins)."""
+    try:
+        failpoints.hit("codec.bomb")
+    except Exception as e:
+        raise CodecError(f"image rejected by decode bomb guard: {e}",
+                         413) from None
+    cap = _DECODE_PIXEL_CAP.get()
+    if cap <= 0.0:
+        return
+    try:
+        b = _backend()
+        fast = getattr(b, "probe_fast", None)
+        if fast is not None and t not in SPECIAL_TYPES:
+            m = fast(buf, t)
+        else:
+            m = probe(buf)
+    except Exception:
+        # unparseable header: the decoder itself raises the user-facing
+        # error (and cannot allocate a frame without dimensions anyway)
+        return
+    if m.width * m.height / 1_000_000.0 > cap:
+        raise CodecError(
+            f"image dimensions {m.width}x{m.height} exceed the "
+            f"{cap:g} megapixel decode limit", 413)
+
+
 def decode(buf: bytes, shrink: int = 1) -> DecodedImage:
     """Decode bytes into an HWC uint8 array (C always 3 or 4).
 
@@ -347,6 +416,7 @@ def decode(buf: bytes, shrink: int = 1) -> DecodedImage:
     if not buf:
         raise CodecError("Empty or unreadable image", 400)
     t = determine_image_type(buf)
+    _bomb_gate(buf, t)
     if t in SPECIAL_TYPES:
         return _decode_special(buf, t, shrink)
     return _backend().decode(buf, t, shrink)

@@ -141,12 +141,29 @@ def _svg_handle(buf: bytes):
 
 
 import hashlib
+from collections import OrderedDict
 
 # sha1(svg bytes) -> (w, h). Keyed on a 20-byte digest, NOT the payload:
 # an lru_cache on buf would pin up to 256 entire (multi-MB) request bodies
-# in memory with no size-based eviction. 28 bytes/entry here is negligible.
-_SVG_SIZE_CACHE: dict = {}
+# in memory with no size-based eviction. 28 bytes/entry here is negligible,
+# but the STRUCTURE is still per-process state a key-flood can grow, so it
+# is a real LRU with per-entry eviction + an eviction counter — the same
+# accounting discipline every other cache in the tree carries (cache.py
+# ByteBudgetLRU, the GCRA key store) — instead of the old stop-the-world
+# clear() that dropped 4096 warm entries to admit one.
+_SVG_SIZE_CACHE: OrderedDict = OrderedDict()
 _SVG_SIZE_CACHE_MAX = 4096
+_SVG_SIZE_EVICTIONS = 0
+_svg_cache_lock = threading.Lock()
+
+
+def svg_size_cache_stats() -> dict:
+    """Items/evictions/capacity of the SVG size memo (test + /debugz
+    accounting surface)."""
+    with _svg_cache_lock:
+        return {"items": len(_SVG_SIZE_CACHE),
+                "evictions": _SVG_SIZE_EVICTIONS,
+                "max": _SVG_SIZE_CACHE_MAX}
 
 
 def svg_intrinsic_size(buf: bytes) -> tuple:
@@ -155,19 +172,25 @@ def svg_intrinsic_size(buf: bytes) -> tuple:
     Cached so a request that probes the size (shrink selection, /info) and
     then rasterizes pays one size parse per distinct SVG, leaving only the
     (unavoidable) render parse inside rasterize_svg."""
+    global _SVG_SIZE_EVICTIONS
     digest = hashlib.sha1(buf).digest()
-    hit = _SVG_SIZE_CACHE.get(digest)
-    if hit is not None:
-        return hit
+    with _svg_cache_lock:
+        hit = _SVG_SIZE_CACHE.get(digest)
+        if hit is not None:
+            _SVG_SIZE_CACHE.move_to_end(digest)
+            return hit
     with _lock:
         h = _svg_handle(buf)
         try:
             size = _svg_size_from_handle(h)
         finally:
             _gobject.g_object_unref(ctypes.c_void_p(h))
-    if len(_SVG_SIZE_CACHE) >= _SVG_SIZE_CACHE_MAX:
-        _SVG_SIZE_CACHE.clear()  # rare full reset beats per-entry LRU links
-    _SVG_SIZE_CACHE[digest] = size
+    with _svg_cache_lock:
+        _SVG_SIZE_CACHE[digest] = size
+        _SVG_SIZE_CACHE.move_to_end(digest)
+        while len(_SVG_SIZE_CACHE) > _SVG_SIZE_CACHE_MAX:
+            _SVG_SIZE_CACHE.popitem(last=False)
+            _SVG_SIZE_EVICTIONS += 1
     return size
 
 

@@ -47,7 +47,7 @@ class DeviceRecord:
         "idx", "consecutive_failures", "failures", "successes",
         "breaker_opens", "quarantined_until", "error_ewma",
         "latency_ewma_ms", "last_probe_t", "probes", "readmissions",
-        "last_error",
+        "last_error", "oom_events",
     )
 
     def __init__(self, idx: int):
@@ -56,6 +56,12 @@ class DeviceRecord:
         self.failures = 0
         self.successes = 0
         self.breaker_opens = 0
+        # CAPACITY events (RESOURCE_EXHAUSTED on a launch/drain): the
+        # device is healthy but the batch didn't fit — recorded here for
+        # operators, deliberately NOT a breaker strike (quarantining a
+        # chip for being asked to hold too much would convert a sizing
+        # problem into an availability outage)
+        self.oom_events = 0
         self.quarantined_until = 0.0  # monotonic; 0 = never tripped
         # Slow-moving rates for operators (the breaker itself acts on the
         # consecutive count — an EWMA would both trip late on a hard-down
@@ -84,6 +90,7 @@ class DeviceRecord:
             "failures": self.failures,
             "successes": self.successes,
             "breaker_opens": self.breaker_opens,
+            "oom_events": self.oom_events,
             "quarantined_for_s": round(max(0.0, self.quarantined_until - now), 3),
             "error_ewma": round(self.error_ewma, 4),
             "latency_ewma_ms": round(self.latency_ewma_ms, 3),
@@ -158,6 +165,17 @@ class DeviceHealthRegistry:
                 self.generation += 1
                 return True
             return False
+
+    def note_capacity(self, idx: int, err: object = None) -> None:
+        """Book one OOM/RESOURCE_EXHAUSTED event against device `idx` as
+        a CAPACITY fact, not a fault: the consecutive-failure count and
+        the breaker are untouched (the executor's bisect-retry owns the
+        recovery; the breaker owns actual chip death)."""
+        with self._lock:
+            rec = self._records[idx]
+            rec.oom_events += 1
+            if err is not None:
+                rec.last_error = str(err)[:200]
 
     def note_ok(self, idx: int, latency_ms: Optional[float] = None) -> None:
         with self._lock:

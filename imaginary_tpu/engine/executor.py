@@ -174,6 +174,19 @@ class ExecutorConfig:
     # (the default) keeps the plain queue.Queue — the parity path is the
     # seed's, byte for byte.
     qos: Optional[object] = None
+    # Memory-pressure governor (engine/pressure.MemoryGovernor). When
+    # set: elevated pressure caps admitted batch bytes per device call
+    # (batch_cap_mb) and forces batch-class oversize items to the host;
+    # the governor also reads this executor's in-flight byte ledgers as
+    # its occupancy signals. None (the default) is the parity path —
+    # no pressure check ever runs.
+    pressure: Optional[object] = None
+    # Bound on the OOM bisect-retry recursion: a chunk that RESOURCE_-
+    # EXHAUSTs is split in half and each half retried, at most this many
+    # levels deep; items still OOMing alone at the bottom route to the
+    # host interpreter (or surface the device error for host-inexecutable
+    # plans). 3 levels turns a 16-item chunk into singles.
+    oom_split_depth: int = 3
 
 
 @dataclasses.dataclass
@@ -196,6 +209,15 @@ class ExecutorStats:
     hedges_lost: int = 0  # device finished first; twin result discarded
     hedges_failed: int = 0  # twin raised (device path still owns the request)
     hedges_skipped: int = 0  # eligible but budget-capped
+    # OOM-recovering execution (memory-pressure subsystem): a chunk that
+    # RESOURCE_EXHAUSTs is bisected and retried rather than failed
+    oom_events: int = 0  # OOM'd launches/drains that entered recovery
+    oom_splits: int = 0  # bisections performed during recovery
+    oom_host_routed: int = 0  # single items that still OOM'd, served by host
+    oom_failed: int = 0  # items recovery could not serve anywhere
+    pressure_host_forced: int = 0  # oversize items forced to host (elevated rung)
+    pressure_capped_batches: int = 0  # device calls shrunk by the byte cap
+    device_owed_mb: float = 0.0  # wire MB enqueued/in flight on the device path
     device_ms_per_mb: float = 0.0  # measured drain cost per wire megabyte
     host_ms_per_mpix: float = 0.0  # measured host CPU cost per megapixel
     host_inflight: int = 0  # spilled items executing on host threads right now
@@ -231,6 +253,13 @@ class ExecutorStats:
                 "failed": self.hedges_failed,
                 "skipped_budget": self.hedges_skipped,
             },
+            "oom_events": self.oom_events,
+            "oom_splits": self.oom_splits,
+            "oom_host_routed": self.oom_host_routed,
+            "oom_failed": self.oom_failed,
+            "pressure_host_forced": self.pressure_host_forced,
+            "pressure_capped_batches": self.pressure_capped_batches,
+            "device_owed_mb": round(self.device_owed_mb, 3),
             "device_ms_per_mb": round(self.device_ms_per_mb, 3),
             "host_ms_per_mpix": round(self.host_ms_per_mpix, 3),
             "host_inflight": self.host_inflight,
@@ -398,6 +427,19 @@ class Executor:
         # cheap-key bytes at an expensive arrival's rate.
         self._owed_ms = 0.0
         self._owed_lock = threading.Lock()
+        # Wire megabytes enqueued-and-undone on the device path (charged
+        # and released next to _owed_ms): the governor's device-memory
+        # estimate and the byte-cap's denominator.
+        self._device_owed_mb = 0.0
+        if self.config.pressure is not None:
+            # the governor was built before this executor existed; hand
+            # it the live occupancy signals it samples (host-pool mpix
+            # approximates imminent RSS at ~12 B/px of f32 RGB scratch,
+            # device wire MB at ~4x for the on-device f32 intermediate)
+            self.config.pressure.bind_sources(
+                host_mb_fn=lambda: self.stats.host_owed_mpix * 12.0,
+                device_mb_fn=lambda: self.stats.device_owed_mb * 4.0,
+            )
         # Per-device fault domains (engine/devhealth.py). Starts at ONE
         # domain — device enumeration initializes the backend, which
         # belongs to the first dispatch (a dead tunnel would hang the
@@ -608,6 +650,23 @@ class Executor:
                 return item.future
         forced = self.config.force_host and host_exec.can_execute(
             plan, for_spill=False)
+        gov = self.config.pressure
+        if (
+            not forced
+            and gov is not None
+            and item.mpix >= gov.config.oversize_mpix
+            # batch-class work (or everything when qos is off — untyped
+            # traffic has no latency contract to protect): oversize
+            # frames stop transiting the device while memory is tight
+            and (item.qos is None or item.qos[1] == _BATCH_CLASS)
+            and gov.level() >= 1  # elevated or critical
+            and host_exec.can_execute(plan, for_spill=False)
+        ):
+            # the elevated brownout rung: ride the existing spill branch
+            # (same gate, same ledger, same placement header)
+            forced = True
+            with self._owed_lock:
+                self.stats.pressure_host_forced += 1
         if forced or (self.config.host_spill and self._should_spill(item)):
             # charge BEFORE the gate: a waiter is backlog, and the
             # occupancy term in _should_spill must see it so follow-up
@@ -687,13 +746,20 @@ class Executor:
             self.stats.host_owed_mpix = self._host_owed_mpix
 
     def _charge_owed(self, item: "_Item") -> None:
-        """Book the item's estimated device milliseconds against the queue;
-        the done-callback releases exactly what was charged."""
+        """Book the item's estimated device milliseconds AND wire bytes
+        against the queue; the done-callback releases exactly what was
+        charged. The byte side is the pressure governor's device-HBM
+        signal: wire MB is what the chip must hold for the item (padded
+        input + output), so the sum over undone items estimates in-use
+        device memory without asking the allocator."""
         est_ms = item.wire_mb * self._rate_for(item.key)
+        mb = item.wire_mb
         with self._owed_lock:
             self._owed_ms += est_ms
             self._device_items += 1  # the hedge budget's denominator
-        item.future.add_done_callback(lambda _f: self._on_done(est_ms))
+            self._device_owed_mb += mb
+            self.stats.device_owed_mb = self._device_owed_mb
+        item.future.add_done_callback(lambda _f: self._on_done(est_ms, mb))
 
     def _rate_for(self, key) -> float:
         """Effective ms/MB for a key: its own measured rate where known,
@@ -706,10 +772,12 @@ class Executor:
             key_rate = self._rate_by_key.get(key)
         return glob if key_rate is None else min(key_rate, 8.0 * glob)
 
-    def _on_done(self, est_ms: float) -> None:
+    def _on_done(self, est_ms: float, wire_mb: float = 0.0) -> None:
         with self._owed_lock:
             self._owed_ms -= est_ms
             self._device_items -= 1
+            self._device_owed_mb = max(0.0, self._device_owed_mb - wire_mb)
+            self.stats.device_owed_mb = self._device_owed_mb
 
     # PR 4 shims: the global breaker's fields live on in tests and
     # operator muscle memory as device 0's record (the degenerate
@@ -1215,8 +1283,15 @@ class Executor:
             self._refresh_mesh_sharding()
             try:
                 failpoints.hit("device.chip_error")
+                failpoints.hit("device.oom")
                 y, arrs, plans = self._launch_chunk(sub)
             except Exception as e:
+                if chain_mod.is_oom_error(e):
+                    # capacity, not fault: bisect-retry unsharded on the
+                    # default device (re-sharding a launch that just
+                    # overflowed the mesh would overflow it again)
+                    self._recover_oom_chunk(sub, None, None, e)
+                    return None
                 self._note_link_failure(e)
                 self._stamp_attempts(sub, ["device:mesh:error"])
                 for it in sub:
@@ -1249,11 +1324,19 @@ class Executor:
             # during an actual outage.
             dev = self._devices[idx] if multi and idx != 0 else None
             try:
-                # chaos site, keyed by device index: chip_error[k] kills
-                # chip k specifically while its peers keep serving
+                # chaos sites, keyed by device index: chip_error[k] kills
+                # chip k specifically while its peers keep serving;
+                # oom[k] simulates chip k's allocator at its ceiling
                 failpoints.hit("device.chip_error", key=idx)
+                failpoints.hit("device.oom", key=idx)
                 y, arrs, plans = self._launch_chunk(sub, device=dev)
             except Exception as e:
+                if chain_mod.is_oom_error(e):
+                    # capacity, not fault: the chunk didn't fit — bisect
+                    # and retry ON THIS device (no breaker strike, no
+                    # failover; the chip is healthy, the batch was big)
+                    self._recover_oom_chunk(sub, dev, idx, e)
+                    return None
                 err = e
                 self._note_device_failure(idx, e)
                 attempts.append(f"device:{idx}:error")
@@ -1299,8 +1382,7 @@ class Executor:
                     it.future.set_exception(e)
             return
         launched = 0
-        for start in range(0, len(items), self.config.max_batch):
-            sub = items[start : start + self.config.max_batch]
+        for sub in self._chunk_for_launch(items):
             chunk = self._launch_with_failover(sub)
             if chunk is None:
                 continue  # that chunk's futures already carry the error
@@ -1321,6 +1403,120 @@ class Executor:
             self._inflight += 1
         # blocks when max_inflight groups are queued: natural backpressure
         self._fetch_queue.put((chunks, cold))
+
+    def _chunk_for_launch(self, items: list) -> list:
+        """Slice a group into device-call chunks: <= max_batch items each,
+        and — under memory pressure — <= the governor's batch byte cap in
+        wire MB (floor one item). Capping ADMITTED bytes makes OOM
+        bisect-retry the exception rather than the routine: a tight chip
+        sees small launches up front instead of failing big ones."""
+        cap_mb = 0.0
+        gov = self.config.pressure
+        if gov is not None:
+            cap_mb = gov.batch_cap_mb()
+        if cap_mb <= 0.0:
+            return [items[s: s + self.config.max_batch]
+                    for s in range(0, len(items), self.config.max_batch)]
+        subs: list = []
+        cur: list = []
+        cur_mb = 0.0
+        for it in items:
+            if cur and (len(cur) >= self.config.max_batch
+                        or cur_mb + it.wire_mb > cap_mb):
+                subs.append(cur)
+                cur, cur_mb = [], 0.0
+            cur.append(it)
+            cur_mb += it.wire_mb
+        if cur:
+            subs.append(cur)
+        base = -(-len(items) // self.config.max_batch)  # uncapped chunk count
+        if len(subs) > base:
+            with self._owed_lock:
+                self.stats.pressure_capped_batches += len(subs) - base
+        return subs
+
+    # -- OOM-recovering execution (memory-pressure subsystem) ------------------
+
+    def _recover_oom_chunk(self, items: list, device, idx, err,
+                           depth: int = 0) -> None:
+        """Bisect-retry a chunk that RESOURCE_EXHAUSTED: split in half,
+        relaunch each half SYNCHRONOUSLY on the same device (the failure
+        was capacity, not the chip — moving would only spread the
+        pressure), recurse on halves that still OOM up to
+        oom_split_depth, and route items that OOM alone to the host
+        interpreter. Books a capacity event on the device's health
+        record — never a breaker strike: quarantining a healthy chip for
+        an oversized launch would turn a sizing problem into an outage.
+
+        Runs on the collector thread (launch-site OOM) or the fetcher
+        (drain-site OOM); blocking it for the retry is the point — the
+        items are already owed answers and everything behind them would
+        hit the same full chip."""
+        didx = idx if idx is not None else 0
+        if depth == 0:
+            with self._owed_lock:
+                self.stats.oom_events += 1
+            self.devhealth.note_capacity(didx, err)
+        live = [it for it in items if not it.future.done()]
+        if not live:
+            return
+        if len(live) > 1 and depth < self.config.oom_split_depth:
+            with self._owed_lock:
+                self.stats.oom_splits += 1
+            mid = (len(live) + 1) // 2
+            for half in (live[:mid], live[mid:]):
+                if not half:
+                    continue
+                try:
+                    # the chaos site fires on every retry level too, so an
+                    # armed probability keeps pushing the bisect deeper —
+                    # exactly how a chip at its ceiling behaves
+                    failpoints.hit("device.oom", key=didx)
+                    outs = chain_mod.run_batch(
+                        [it.arr for it in half], [it.plan for it in half],
+                        device=device)
+                except Exception as e:
+                    if chain_mod.is_oom_error(e):
+                        self._recover_oom_chunk(half, device, idx, e,
+                                                depth + 1)
+                    else:
+                        for it in half:
+                            if not it.future.done():
+                                it.future.set_exception(e)
+                    continue
+                self._stamp_attempts(
+                    half, [f"device:{didx}:oom", f"device:{didx}:oom_split"])
+                for it, out in zip(half, outs):
+                    if not it.future.done():
+                        it.future.set_result(out)
+            return
+        # single item (or split budget exhausted): the device cannot hold
+        # it right now — serve from the host interpreter when the plan
+        # allows, else surface the real device error
+        for it in live:
+            if host_exec.can_execute(it.plan, for_spill=False):
+                try:
+                    out = host_exec.run(it.arr, it.plan)
+                except Exception:
+                    pass  # fall through to the error path below
+                else:
+                    with self._owed_lock:
+                        self.stats.oom_host_routed += 1
+                    self._stamp_attempts(
+                        [it], [f"device:{didx}:oom", "host_spill"])
+                    # placement override for the response header: these
+                    # pixels came from the host interpreter (same flag the
+                    # hedge winner uses; handlers read it off the future)
+                    it.future._hedge_placement = "host"
+                    if not it.future.done():
+                        it.future.set_result(out)
+                    continue
+            with self._owed_lock:
+                self.stats.oom_failed += 1
+            if not it.future.done():
+                it.future.set_exception(
+                    err if isinstance(err, Exception)
+                    else RuntimeError("device out of memory"))
 
     def _watchdog_loop(self):
         """Abandon drains stuck past drain_watchdog_s (see ExecutorConfig).
@@ -1419,6 +1615,21 @@ class Executor:
                         self._drain_state = None
                 if not live:
                     return  # watchdog already failed the futures + inflight
+                if chain_mod.is_oom_error(e):
+                    # drain-site OOM (XLA surfaces RESOURCE_EXHAUSTED at
+                    # materialization, not dispatch): recover each chunk
+                    # by bisect-retry on its own device — capacity, not
+                    # fault, so no breaker strike and no failover
+                    for c in chunks:
+                        cidx = c[4]
+                        dev = (self._devices[cidx]
+                               if (self._devices and cidx is not None
+                                   and cidx != 0
+                                   and cidx < len(self._devices)) else None)
+                        self._recover_oom_chunk(c[3], dev, cidx, e)
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                    continue
                 # a failed drain strikes every fault domain it rode (one
                 # EVENT per device; for one device this is the PR 4 "one
                 # failure per drain error", byte for byte)

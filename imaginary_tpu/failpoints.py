@@ -31,6 +31,18 @@ Sites (each named for the subsystem boundary it sits on):
   host.spill       the host SIMD spill branch (engine/executor.py)
   codec.encode     host image encode (pipeline.py, pool thread)
   cache.get        any cache-tier lookup (cache.py ByteBudgetLRU)
+  memory.rss       the pressure governor's RSS sample (engine/pressure.py):
+                   an injected error simulates RSS at the configured
+                   ceiling, driving the whole brownout ladder without
+                   actually exhausting the host
+  device.oom       one chunk launch/bisect-retry on one DEVICE
+                   (engine/executor.py); keyable by device index — an
+                   injected error reads as RESOURCE_EXHAUSTED and takes
+                   the bisect-retry -> host-routing recovery path, never
+                   the breaker
+  codec.bomb       the pre-decode bomb gate (codecs/__init__.py): an
+                   injected error rejects the decode 413 exactly as a
+                   header-dimension bomb would
 
 Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
 
@@ -74,6 +86,9 @@ SITES = (
     "host.spill",
     "codec.encode",
     "cache.get",
+    "memory.rss",
+    "device.oom",
+    "codec.bomb",
 )
 
 # keyed-site spelling: site[key], key limited to a safe token charset

@@ -97,6 +97,14 @@ def debug_payload(service) -> dict:
             "estimated_queue_ms": round(service.estimated_queue_ms(), 3),
         }
         payload["cache"] = service.caches.to_dict()
+        governor = getattr(service, "pressure", None)
+        if governor is not None:
+            # governor rung + sampled signals + the full recent
+            # transition history (health shows the last 8; diagnosis of a
+            # flapping ladder wants the whole ring)
+            snap = governor.snapshot()
+            snap["recent_transitions"] = list(governor._history)
+            payload["pressure"] = snap
         qos = getattr(service, "qos", None)
         if qos is not None:
             # secret-free tenant table + per-class counters + live intake

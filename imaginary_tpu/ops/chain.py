@@ -253,9 +253,35 @@ def fetch_batch(y, arrs: list, plans: list) -> list:
     return finish_batch(np.asarray(jax.device_get(y)), arrs, plans)
 
 
-def run_batch(arrs: list, plans: list, sharding=None) -> list:
-    """Synchronous convenience: launch + fetch in one call."""
-    return fetch_batch(launch_batch(arrs, plans, sharding=sharding), arrs, plans)
+def run_batch(arrs: list, plans: list, sharding=None, device=None) -> list:
+    """Synchronous convenience: launch + fetch in one call. `device`
+    pins the launch (the executor's OOM bisect-retry relaunches halves
+    on the SAME device the full batch overflowed — the failure was
+    capacity, not the chip, so moving would only spread the pressure)."""
+    return fetch_batch(
+        launch_batch(arrs, plans, sharding=sharding, device=device),
+        arrs, plans)
+
+
+# Substrings that identify an allocator/HBM exhaustion in the zoo of
+# exceptions the device runtime can raise: jaxlib surfaces XLA's status
+# as XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory ..."), the CPU
+# fallback raises plain MemoryError from numpy staging, and the
+# device.oom chaos site mints FailpointErrors named for itself.
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory",
+                "failed to allocate", "device.oom")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """True when an exception reads as memory exhaustion rather than a
+    chip/link fault. The executor routes these to bisect-retry (a
+    capacity event) instead of the per-device breaker (a fault event):
+    half the batch usually fits, and quarantining a healthy chip for an
+    oversized launch would turn a sizing problem into an outage."""
+    if isinstance(e, MemoryError):
+        return True
+    s = str(e).lower()
+    return any(m in s for m in _OOM_MARKERS)
 
 
 def run_single(arr: np.ndarray, plan: ImagePlan) -> np.ndarray:

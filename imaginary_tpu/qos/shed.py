@@ -29,6 +29,24 @@ from imaginary_tpu.qos import CLASSES
 # "shed_fractions" map.
 DEFAULT_SHED_FRACTIONS = (1.0, 0.75, 0.5)
 
+# Memory-pressure brownout (engine/pressure.py): the MINIMUM governor
+# level at which each class is shed outright, index-aligned with CLASSES.
+# Only the batch class sheds, and only at critical — interactive and
+# standard traffic is instead bounded by the pixel-admission clamp and
+# the executor's batch byte cap; batch work is the class whose deferral
+# the operator already sold (same DAGOR logic as the queue grading above,
+# applied to a different scarce resource).
+PRESSURE_SHED_LEVELS = (99, 99, 2)
+
+
+def shed_for_pressure(level: int, class_index: int) -> bool:
+    """True when the governor's current rung sheds this class outright
+    (503 + Retry-After, the overload contract). `class_index` beyond the
+    known classes (defensive) never sheds."""
+    if class_index < 0 or class_index >= len(PRESSURE_SHED_LEVELS):
+        return False
+    return level >= PRESSURE_SHED_LEVELS[class_index]
+
 
 class TenantShareExceeded(ImageError):
     """A tenant's in-queue share cap rejected the N+1th queued item.

@@ -8,7 +8,6 @@ optional periodic memory release (ref: imaginary.go:339-347).
 from __future__ import annotations
 
 import asyncio
-import gc
 import ssl
 from functools import partial
 from typing import Optional
@@ -57,16 +56,26 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     from imaginary_tpu.qos.tenancy import load_policy
 
     qos = load_policy(o.qos_config)
+    # Memory-pressure governor (engine/pressure.py): built ONCE here like
+    # the qos policy and shared by everyone who reads a slice of it — the
+    # trace middleware (per-request level annotation), the service
+    # (admission ladder + cache shrink callback), and the executor
+    # (batch byte cap, oversize-to-host, occupancy signals). None when
+    # --pressure-rss-mb is 0: every consumer takes its parity path.
+    from imaginary_tpu.engine import pressure as pressure_mod
+
+    governor = pressure_mod.from_options(o)
     # trace middleware is OUTERMOST: it assigns request identity and
     # installs the contextvar trace before the access log (which reads
     # the id) and everything inside it runs
     app = web.Application(
-        middlewares=[trace_middleware(o, log_stream, qos=qos),
+        middlewares=[trace_middleware(o, log_stream, qos=qos,
+                                      pressure=governor),
                      access_log_middleware(o.log_level, log_stream)]
         + build_middlewares(o, qos=qos),
         client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
     )
-    service = ImageService(o, qos=qos)
+    service = ImageService(o, qos=qos, pressure=governor)
     app["service"] = service
     app["options"] = o
 
@@ -290,10 +299,17 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
             loop.add_signal_handler(sig, stop.set)
 
         async def memory_release():
-            # role of the reference's FreeOSMemory ticker (imaginary.go:339-347)
+            # Role of the reference's FreeOSMemory ticker
+            # (imaginary.go:339-347) — but actually returning memory:
+            # gc.collect alone frees objects into glibc's arena where the
+            # pages STAY RESIDENT; release_memory follows it with
+            # malloc_trim so the freed tail goes back to the kernel and
+            # RSS really drops (Linux best-effort, no-op elsewhere).
+            from imaginary_tpu.engine.pressure import release_memory
+
             while not stop.is_set():
                 await asyncio.sleep(max(mrelease, 1))
-                gc.collect()
+                release_memory()
 
         ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
         scheme = "https" if o.cert_file and o.key_file else "http"

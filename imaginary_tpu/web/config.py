@@ -70,6 +70,25 @@ class ServerOptions:
     # the request deadline) decides what happens next.
     source_connect_timeout_s: float = 5.0
     source_read_timeout_s: float = 30.0
+    # --- memory-pressure resilience (imaginary_tpu/engine/pressure.py) -------
+    # RSS ceiling in MB for the pressure governor. 0 = the whole
+    # subsystem OFF (parity: no governor is built, no pressure check ever
+    # runs, responses are byte-identical to the pre-pressure build).
+    pressure_rss_mb: float = 0.0
+    # Estimated device-HBM budget in MB fed by the executor's per-batch
+    # wire-byte ledger; 0 skips the device signal.
+    pressure_hbm_mb: float = 0.0
+    # Rung thresholds as fractions of a limit: elevated at 75%, critical
+    # at 90% (5-point hysteresis on the way down; see PressureConfig).
+    pressure_elevated_frac: float = 0.75
+    pressure_critical_frac: float = 0.90
+    # Elevated/critical rung knobs: admitted device-batch wire-MB cap
+    # (halved at critical), the megapixel size at which batch-class work
+    # is forced to the host, and the fraction of --max-allowed-resolution
+    # the critical pixel-admission clamp allows.
+    pressure_batch_mb: float = 32.0
+    pressure_oversize_mpix: float = 4.0
+    pressure_pixel_frac: float = 0.25
     # --- multi-tenant QoS (imaginary_tpu/qos/) -------------------------------
     # Tenant table + scheduler/shed knobs: inline JSON (starts with '{')
     # or a file path; parsed once at assembly (qos/tenancy.load_policy).

@@ -27,6 +27,7 @@ from imaginary_tpu import codecs
 from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu import failpoints
 from imaginary_tpu.engine import Executor, ExecutorConfig
+from imaginary_tpu.engine import pressure as pressure_mod
 from imaginary_tpu.errors import (
     ErrEmptyBody,
     ErrNotFound,
@@ -79,7 +80,7 @@ class ImageService:
     """Owns the micro-batch executor, the host thread pool (decode/encode
     parallelism), and the source registry."""
 
-    def __init__(self, o: ServerOptions, qos=None):
+    def __init__(self, o: ServerOptions, qos=None, pressure=None):
         self.options = o
         # multi-tenant QoS policy (imaginary_tpu/qos/): create_app builds
         # it once and passes it in; direct constructors (tests, benches)
@@ -89,6 +90,15 @@ class ImageService:
 
             qos = load_policy(o.qos_config)
         self.qos = qos
+        # memory-pressure governor (engine/pressure.py): same pattern as
+        # qos — create_app builds and shares it, direct constructors
+        # derive it from the options. None = the subsystem is off and no
+        # pressure check ever runs (parity).
+        if pressure is None:
+            from imaginary_tpu.engine import pressure as pressure_mod
+
+            pressure = pressure_mod.from_options(o)
+        self.pressure = pressure
         # content-addressed cache tiers (imaginary_tpu/cache.py): result
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
@@ -96,6 +106,12 @@ class ImageService:
         self.frame_cache = cache_mod.FrameCache(self.caches.frames,
                                                 self.caches.stats)
         self.registry = SourceRegistry(o, caches=self.caches)
+        if pressure is not None:
+            # cache tiers shrink/restore their budgets on the governor's
+            # transition edge (elevated halves, critical quarters +
+            # disables the source cache), not by per-request polling
+            pressure.on_transition(
+                lambda _old, new: self.caches.apply_pressure(new))
         self.executor = Executor(
             ExecutorConfig(
                 window_ms=o.batch_window_ms,
@@ -109,6 +125,7 @@ class ImageService:
                 hedge_threshold_ms=o.hedge_threshold_ms,
                 hedge_budget=o.hedge_budget,
                 qos=qos,
+                pressure=pressure,
             )
         )
         from imaginary_tpu.engine.executor import _available_cpus
@@ -169,6 +186,27 @@ class ImageService:
                 raise new_error(
                     "Request shed by admission control, retry later", 503,
                     headers={"Retry-After": "1"}) from None
+            gov = self.pressure
+            if gov is not None:
+                # the brownout ladder's admission rung: sample the
+                # governor once per request, stamp the level into the
+                # trace (wide events / slow ring ride along), and at
+                # critical shed batch-class work outright — the class
+                # whose deferral is already sold, 503 + Retry-After like
+                # every other shed in this codebase
+                plevel = gov.level()
+                if tr is not None and tr.enabled:
+                    tr.annotate(pressure=pressure_mod.LEVEL_NAMES[plevel])
+                from imaginary_tpu.qos.shed import shed_for_pressure
+
+                if qos is not None and shed_for_pressure(plevel, kidx):
+                    gov.note_shed()
+                    qos.stats.note_shed(kidx)
+                    if tr is not None:
+                        tr.annotate(placement_attempts=["shed_503"])
+                    raise new_error(
+                        "Server under memory pressure, batch work shed, "
+                        "retry later", 503, headers={"Retry-After": "2"})
             est_ms = None
             if o.max_queue_ms > 0 or dl is not None:
                 est_ms = self.estimated_queue_ms()
@@ -260,14 +298,53 @@ class ImageService:
         # resolution guard (ref: controllers.go:101-110). probe_fast is the
         # header-only parser; the metadata is reused downstream so the hot
         # path pays exactly one header parse per request.
+        #
+        # With the pressure subsystem armed (governor non-None) the same
+        # guard grows three teeth, all PARITY-off without it:
+        #   * the codec-level pre-decode gate is armed in this request's
+        #     context (copy_context carries it into pool threads), so a
+        #     bomb whose header this probe couldn't parse still cannot
+        #     make any decode — including the watermark fetch — allocate
+        #     past the cap;
+        #   * over-cap sources answer 413 (the payload demands more
+        #     memory than this server will commit) instead of the
+        #     reference's 422 — PARITY r11 notes the divergence;
+        #   * at critical pressure, admission clamps to pixel_frac of the
+        #     cap for BOTH source dims and the requested output dims (an
+        #     8K enlarge of a thumbnail is an output-side memory bomb).
+        gov = self.pressure
+        limit_mpix = o.max_allowed_pixels
+        clamp_mpix = 0.0
+        if gov is not None and limit_mpix > 0:
+            codecs.set_decode_pixel_cap(limit_mpix)
+            if gov.level() >= pressure_mod.LEVEL_CRITICAL:
+                clamp_mpix = limit_mpix * gov.config.pixel_frac
+        if clamp_mpix > 0.0:
+            out_w = getattr(opts, "width", 0) or 0
+            out_h = getattr(opts, "height", 0) or 0
+            if out_w > 0 and out_h > 0 and out_w * out_h / 1e6 > clamp_mpix:
+                gov.note_pixel_clamp()
+                raise new_error(
+                    "Requested output resolution exceeds the memory-"
+                    "pressure admission clamp, retry later", 413,
+                    headers={"Retry-After": "2"})
         meta = None
-        if o.max_allowed_pixels > 0:
+        if limit_mpix > 0:
             try:
                 meta = codecs.probe_fast(buf)
-                if (meta.width * meta.height / 1_000_000.0) > o.max_allowed_pixels:
+                src_mpix = meta.width * meta.height / 1_000_000.0
+                if clamp_mpix > 0.0 and src_mpix > clamp_mpix:
+                    gov.note_pixel_clamp()
+                    raise new_error(
+                        "Image resolution exceeds the memory-pressure "
+                        "admission clamp, retry later", 413,
+                        headers={"Retry-After": "2"})
+                if src_mpix > limit_mpix:
+                    if gov is not None:
+                        raise new_error("Image resolution is too big", 413)
                     raise ErrResolutionTooBig
             except ImageError as e:
-                if e is ErrResolutionTooBig:
+                if e is ErrResolutionTooBig or e.code == 413:
                     raise
                 # probe failure falls through; decode will produce the error
 
@@ -527,7 +604,8 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
     """The ONE stats assembly /health and /metrics both serve (they must
     never drift — /metrics promises 'the same numbers as /health')."""
     stats = get_health_stats(service.executor if service else None,
-                             qos=service.qos if service else None)
+                             qos=service.qos if service else None,
+                             pressure=service.pressure if service else None)
     if service is not None:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads

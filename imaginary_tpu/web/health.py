@@ -27,7 +27,7 @@ def _rss_mb() -> float:
     return 0.0
 
 
-def get_health_stats(executor=None, qos=None) -> dict:
+def get_health_stats(executor=None, qos=None, pressure=None) -> dict:
     import gc
 
     stats = {
@@ -66,6 +66,12 @@ def get_health_stats(executor=None, qos=None) -> dict:
         # QosStats); /metrics renders the same block as
         # imaginary_tpu_qos_* so the two surfaces cannot drift
         stats["qos"] = qos.stats.to_dict()
+    if pressure is not None:
+        # memory-pressure governor (engine/pressure.py): current rung,
+        # the sampled RSS/occupancy signals, per-rung transition counters
+        # and ladder-action counts; /metrics renders the same block as
+        # imaginary_tpu_pressure_* so the two surfaces cannot drift
+        stats["pressure"] = pressure.snapshot()
     from imaginary_tpu.engine.timing import TIMES
 
     stage_times = TIMES.snapshot()

@@ -30,7 +30,7 @@ _EXEC_GAUGES = {
     "avg_batch", "avg_group", "max_group", "queue_depth",
     "compile_cache_size", "device_ms_per_mb", "host_ms_per_mpix",
     "host_inflight", "host_owed_mpix", "host_spill_p50_ms",
-    "host_spill_p99_ms",
+    "host_spill_p99_ms", "device_owed_mb",
 }
 _CACHE_GAUGES = {
     "result_items", "result_bytes", "frame_items", "frame_bytes",
@@ -77,8 +77,15 @@ def render_metrics(stats: dict) -> str:
     qos_classes: dict = {}
     hedge_outcomes: dict = {}
     device_health: dict = {}
+    pressure: dict = {}
+    oom_splits = None
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
+            # the ISSUE-named headline counter rides under its own name
+            # next to the imaginary_tpu_executor_* rendering of the same
+            # block (dashboards grep for it; the executor family remains
+            # the full surface)
+            oom_splits = value.get("oom_splits")
             for k, v in value.items():
                 if k == "hedges" and isinstance(v, dict):
                     # deferred: one labeled family
@@ -91,6 +98,8 @@ def render_metrics(stats: dict) -> str:
                        help_text=f"Executor {k.replace('_', ' ')} (see /health).")
         elif key == "deviceHealth" and isinstance(value, dict):
             device_health = value
+        elif key == "pressure" and isinstance(value, dict):
+            pressure = value
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
@@ -171,6 +180,34 @@ def render_metrics(stats: dict) -> str:
                 help_text="Per-device fault-domain state "
                           "(healthy|quarantined|half_open); value is "
                           "always 1.")
+    if oom_splits is not None:
+        x.emit("imaginary_tpu_oom_splits_total", oom_splits, mtype="counter",
+               help_text="Device-batch bisections performed by the OOM "
+                         "recovery path.")
+    if pressure:
+        x.emit("imaginary_tpu_pressure_state", pressure.get("state", 0),
+               help_text="Memory-pressure rung (0=ok 1=elevated "
+                         "2=critical).")
+        x.emit("imaginary_tpu_pressure_rss_mb", pressure.get("rss_mb", 0.0),
+               help_text="Sampled process RSS in MB (governor view).")
+        x.emit("imaginary_tpu_pressure_rss_limit_mb",
+               pressure.get("rss_limit_mb", 0.0),
+               help_text="Configured RSS ceiling in MB.")
+        x.emit("imaginary_tpu_pressure_ratio", pressure.get("ratio", 0.0),
+               help_text="Worst-signal pressure ratio (used/limit).")
+        for rung, v in sorted(
+                (pressure.get("transitions") or {}).items()):
+            x.emit("imaginary_tpu_pressure_transitions_total", v,
+                   f'level="{escape_label_value(rung)}"', mtype="counter",
+                   help_text="Entries into each pressure rung.")
+        x.emit("imaginary_tpu_pressure_batch_sheds_total",
+               pressure.get("batch_sheds", 0), mtype="counter",
+               help_text="Batch-class requests shed 503 at critical "
+                         "pressure.")
+        x.emit("imaginary_tpu_pressure_pixel_clamps_total",
+               pressure.get("pixel_clamps", 0), mtype="counter",
+               help_text="Requests rejected 413 by the critical-rung "
+                         "pixel-admission clamp.")
     for labels, v in stage_total:
         x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
                help_text="Samples recorded per pipeline stage.")

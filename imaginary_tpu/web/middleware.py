@@ -124,7 +124,8 @@ def _route_label(request: web.Request) -> str:
     return canonical or "unmatched"
 
 
-def trace_middleware(o: ServerOptions, events_out=None, qos=None):
+def trace_middleware(o: ServerOptions, events_out=None, qos=None,
+                     pressure=None):
     """Outermost middleware: request identity + trace lifecycle.
 
     Assigns/propagates X-Request-ID and W3C traceparent, installs the
@@ -155,6 +156,14 @@ def trace_middleware(o: ServerOptions, events_out=None, qos=None):
             tr.tenant = ten
             if tr.enabled:
                 tr.annotate(tenant=ten.name, qos_class=ten.klass)
+        if pressure is not None and tr.enabled:
+            # the memory-pressure rung this request was admitted under:
+            # EVERY traced request carries it (public paths included), so
+            # wide events and the slow ring can correlate a latency cliff
+            # with the brownout ladder engaging (the image handler
+            # re-stamps after its own sample — last write wins, both
+            # agree within one sample interval)
+            tr.annotate(pressure=pressure.level_name())
         # Mint the end-to-end deadline next to the request id: the budget
         # is the server default, lowered (never raised) by the client's
         # X-Request-Timeout header. It rides the trace contextvar so every

@@ -31,6 +31,13 @@ import pytest  # noqa: E402
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "testdata")
 
 
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow'; register the marker so strict runs and
+    # warning-free output both hold
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the tier-1 gate")
+
+
 @pytest.fixture(autouse=True)
 def _isolate_link_seed(monkeypatch):
     """prewarm_common_chains installs a process-global link-rate seed that
