@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory chaos serve clean gate lint
+.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory chaos serve clean gate lint check
 
 all: native test
 
@@ -23,7 +23,7 @@ gate: lint test chaos
 	  { echo "bench_qos.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=4 BENCH_CONCURRENCY=6 python bench_memory.py || \
 	  { echo "bench_memory.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory benches all pass"
+	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory benches all pass"
 
 # Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7): the deadline/failpoint/
 # devhealth/pressure suites, then four soaks — a flaky-origin row
@@ -43,10 +43,19 @@ chaos:
 	  JAX_PLATFORMS=cpu python bench_chaos.py || \
 	  { echo "chaos soak failed - resilience invariants violated"; exit 1; }
 
-# correctness-class lint (ruff.toml). FAILS the gate when ruff finds an
-# issue; hosts without ruff installed skip with a notice (the bench
-# containers don't ship it — CI images should).
-lint:
+# Project-invariant static analyzer (imaginary_tpu/tools/itpucheck.py):
+# stdlib-ast only, ships inside the package, so it ALWAYS runs — there
+# is deliberately no "unavailable - SKIPPED" branch here. Exits nonzero
+# on any unsuppressed finding; --json archives the finding count under
+# artifacts/ next to the bench rows. See README "Static analysis".
+check:
+	python -m imaginary_tpu.tools.itpucheck --json artifacts/itpucheck.json
+
+# correctness-class lint: itpucheck (always), then ruff (ruff.toml —
+# syntax errors, undefined names, unused imports/variables/redefinitions).
+# Ruff FAILS the gate when present; hosts without it skip with a notice
+# (the bench containers don't ship it — CI images should).
+lint: check
 	@if python -m ruff --version >/dev/null 2>&1; then \
 	  python -m ruff check .; \
 	elif command -v ruff >/dev/null 2>&1; then \

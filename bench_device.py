@@ -105,7 +105,7 @@ def bench_chain(name, in_h, in_w, out_h, out_w, batches=(1, 8, 16, 32, 64)):
             yd, _, _ = fn(specs, xd, h, w, dyns)
             yd.block_until_ready()
             t2 = time.perf_counter()
-            host = jax.device_get(yd)
+            jax.device_get(yd)
             t3 = time.perf_counter()
             ts_h2d.append((t1 - t0) * 1000)
             ts_cmp.append((t2 - t1) * 1000)

@@ -86,51 +86,116 @@ def _env_bool(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", "on", "yes")
 
 
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, "") or default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="imaginary-tpu",
         description="TPU-native HTTP image processing microservice",
     )
-    # ref flags (imaginary.go:20-55)
-    p.add_argument("-p", "--port", type=int, default=9000, help="TCP port")
-    p.add_argument("-a", "--addr", default="", help="bind address")
-    p.add_argument("--path-prefix", default="/", help="URL path prefix")
-    p.add_argument("--cors", action="store_true", help="enable CORS")
-    p.add_argument("--gzip", action="store_true", help="deprecated no-op (parity)")
-    p.add_argument("--key", default="", help="API key for authorization")
-    p.add_argument("--mount", default="", help="local directory to serve images from")
-    p.add_argument("--http-cache-ttl", type=int, default=-1, help="cache TTL seconds (0=no-cache)")
-    p.add_argument("--http-read-timeout", type=int, default=60)
-    p.add_argument("--http-write-timeout", type=int, default=60)
-    p.add_argument("--enable-url-source", action="store_true", help="allow GET ?url= fetches")
-    p.add_argument("--enable-placeholder", action="store_true", help="placeholder on errors")
-    p.add_argument("--enable-auth-forwarding", action="store_true")
-    p.add_argument("--enable-url-signature", action="store_true")
-    p.add_argument("--url-signature-key", default="")
-    p.add_argument("--allowed-origins", default="", help="CSV of allowed origin URLs")
-    p.add_argument("--max-allowed-size", type=int, default=0, help="max source bytes")
-    p.add_argument("--max-allowed-resolution", type=float, default=18.0, help="max megapixels")
-    p.add_argument("--certfile", default="")
-    p.add_argument("--keyfile", default="")
+    # ref flags (imaginary.go:20-55). Every flag reads its canonical
+    # IMAGINARY_TPU_<FLAG> env override in its default (ITPU005 pins the
+    # spelling; container deployments script knobs without a wrapper).
+    # Historical env names (PORT, URL_SIGNATURE_KEY, LOG_LEVEL) still win
+    # in options_from_args for back-compat.
+    p.add_argument("-p", "--port", type=int,
+                   default=_env_int("IMAGINARY_TPU_PORT", 9000), help="TCP port")
+    p.add_argument("-a", "--addr", default=_env_str("IMAGINARY_TPU_ADDR", ""),
+                   help="bind address")
+    p.add_argument("--path-prefix",
+                   default=_env_str("IMAGINARY_TPU_PATH_PREFIX", "/"),
+                   help="URL path prefix")
+    p.add_argument("--cors", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_CORS"), help="enable CORS")
+    p.add_argument("--gzip", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_GZIP"),
+                   help="deprecated no-op (parity)")
+    p.add_argument("--key", default=_env_str("IMAGINARY_TPU_KEY", ""),
+                   help="API key for authorization")
+    p.add_argument("--mount", default=_env_str("IMAGINARY_TPU_MOUNT", ""),
+                   help="local directory to serve images from")
+    p.add_argument("--http-cache-ttl", type=int,
+                   default=_env_int("IMAGINARY_TPU_HTTP_CACHE_TTL", -1),
+                   help="cache TTL seconds (0=no-cache)")
+    p.add_argument("--http-read-timeout", type=int,
+                   default=_env_int("IMAGINARY_TPU_HTTP_READ_TIMEOUT", 60))
+    p.add_argument("--http-write-timeout", type=int,
+                   default=_env_int("IMAGINARY_TPU_HTTP_WRITE_TIMEOUT", 60))
+    p.add_argument("--enable-url-source", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_ENABLE_URL_SOURCE"),
+                   help="allow GET ?url= fetches")
+    p.add_argument("--enable-placeholder", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_ENABLE_PLACEHOLDER"),
+                   help="placeholder on errors")
+    p.add_argument("--enable-auth-forwarding", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_ENABLE_AUTH_FORWARDING"))
+    p.add_argument("--enable-url-signature", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_ENABLE_URL_SIGNATURE"))
+    p.add_argument("--url-signature-key",
+                   default=_env_str("IMAGINARY_TPU_URL_SIGNATURE_KEY", ""))
+    p.add_argument("--allowed-origins",
+                   default=_env_str("IMAGINARY_TPU_ALLOWED_ORIGINS", ""),
+                   help="CSV of allowed origin URLs")
+    p.add_argument("--max-allowed-size", type=int,
+                   default=_env_int("IMAGINARY_TPU_MAX_ALLOWED_SIZE", 0),
+                   help="max source bytes")
+    p.add_argument("--max-allowed-resolution", type=float,
+                   default=_env_float("IMAGINARY_TPU_MAX_ALLOWED_RESOLUTION", 18.0),
+                   help="max megapixels")
+    p.add_argument("--certfile", default=_env_str("IMAGINARY_TPU_CERTFILE", ""))
+    p.add_argument("--keyfile", default=_env_str("IMAGINARY_TPU_KEYFILE", ""))
     p.add_argument("--require-device", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_REQUIRE_DEVICE"),
                    help="refuse to start when the accelerator is unreachable "
                         "(default: fall back to the CPU backend with a warning)")
     p.add_argument("--disable-http2", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_DISABLE_HTTP2"),
                    help="serve http/1.1 only over TLS (h2 is on by default, like the reference)")
-    p.add_argument("--authorization", default="", help="fixed Authorization header for origins")
-    p.add_argument("--forward-headers", default="", help="CSV of headers to forward")
-    p.add_argument("--placeholder", default="", help="placeholder image path")
-    p.add_argument("--placeholder-status", type=int, default=0)
-    p.add_argument("--concurrency", type=int, default=0, help="rate limit (req/sec)")
-    p.add_argument("--burst", type=int, default=100, help="rate limit burst")
-    p.add_argument("--mrelease", type=int, default=30, help="memory release interval seconds")
-    p.add_argument("--cpus", type=int, default=0, help="worker thread cap (0=auto)")
-    p.add_argument("--log-level", default="info", choices=["debug", "info", "warning", "error"])
-    p.add_argument("--return-size", action="store_true", help="Image-Width/Height headers")
-    p.add_argument("--disable-endpoints", default="", help="CSV of endpoints to disable")
+    p.add_argument("--authorization",
+                   default=_env_str("IMAGINARY_TPU_AUTHORIZATION", ""),
+                   help="fixed Authorization header for origins")
+    p.add_argument("--forward-headers",
+                   default=_env_str("IMAGINARY_TPU_FORWARD_HEADERS", ""),
+                   help="CSV of headers to forward")
+    p.add_argument("--placeholder",
+                   default=_env_str("IMAGINARY_TPU_PLACEHOLDER", ""),
+                   help="placeholder image path")
+    p.add_argument("--placeholder-status", type=int,
+                   default=_env_int("IMAGINARY_TPU_PLACEHOLDER_STATUS", 0))
+    p.add_argument("--concurrency", type=int,
+                   default=_env_int("IMAGINARY_TPU_CONCURRENCY", 0),
+                   help="rate limit (req/sec)")
+    p.add_argument("--burst", type=int,
+                   default=_env_int("IMAGINARY_TPU_BURST", 100),
+                   help="rate limit burst")
+    p.add_argument("--mrelease", type=int,
+                   default=_env_int("IMAGINARY_TPU_MRELEASE", 30),
+                   help="memory release interval seconds")
+    p.add_argument("--cpus", type=int,
+                   default=_env_int("IMAGINARY_TPU_CPUS", 0),
+                   help="worker thread cap (0=auto)")
+    p.add_argument("--log-level",
+                   default=_env_str("IMAGINARY_TPU_LOG_LEVEL", "info"),
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--return-size", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_RETURN_SIZE"),
+                   help="Image-Width/Height headers")
+    p.add_argument("--disable-endpoints",
+                   default=_env_str("IMAGINARY_TPU_DISABLE_ENDPOINTS", ""),
+                   help="CSV of endpoints to disable")
     p.add_argument("--version", action="store_true")
     # TPU engine flags (no reference counterpart)
-    p.add_argument("--max-queue-ms", type=float, default=0.0,
+    p.add_argument("--max-queue-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_MAX_QUEUE_MS", 0.0),
                    help="shed load (503) when estimated queueing delay "
                         "exceeds this; 0 disables")
     # request lifecycle robustness (imaginary_tpu/deadline.py +
@@ -142,13 +207,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "enforced at every hop (admission, fetch, queue, "
                         "execute, encode); also the clamp ceiling for the "
                         "X-Request-Timeout header; 0 disables")
-    p.add_argument("--source-retries", type=int, default=2,
+    p.add_argument("--source-retries", type=int,
+                   default=_env_int("IMAGINARY_TPU_SOURCE_RETRIES", 2),
                    help="retry budget for remote ?url=/watermark fetches "
                         "(connect errors, timeouts, 5xx, 429; exponential "
                         "backoff + full jitter, honors Retry-After)")
-    p.add_argument("--source-connect-timeout", type=float, default=5.0,
+    p.add_argument("--source-connect-timeout", type=float,
+                   default=_env_float("IMAGINARY_TPU_SOURCE_CONNECT_TIMEOUT", 5.0),
                    help="per-attempt origin connect timeout in seconds")
-    p.add_argument("--source-read-timeout", type=float, default=30.0,
+    p.add_argument("--source-read-timeout", type=float,
+                   default=_env_float("IMAGINARY_TPU_SOURCE_READ_TIMEOUT", 30.0),
                    help="per-attempt origin total read timeout in seconds")
     # memory-pressure resilience (imaginary_tpu/engine/pressure.py):
     # governor + brownout ladder + OOM bisect-retry; defaults OFF
@@ -201,24 +269,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "(interactive|standard|batch), rate/burst "
                         "overrides, and a max queue share (see README "
                         "Multi-tenant QoS); empty disables qos")
-    p.add_argument("--workers", type=int, default=1,
+    p.add_argument("--workers", type=int,
+                   default=_env_int("IMAGINARY_TPU_WORKERS", 1),
                    help="serving processes on one port via SO_REUSEPORT "
                         "(0 = one per CPU core); worker 0 owns the device, "
                         "the rest serve on the host backend")
-    p.add_argument("--batch-window-ms", type=float, default=3.0, help="micro-batch window")
-    p.add_argument("--max-batch", type=int, default=16, help="micro-batch size cap")
-    p.add_argument("--use-mesh", action="store_true", help="shard batches over the device mesh")
-    p.add_argument("--devices", type=int, default=0, help="device count (0=all)")
-    p.add_argument("--spatial", type=int, default=1,
+    p.add_argument("--batch-window-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_BATCH_WINDOW_MS", 3.0),
+                   help="micro-batch window")
+    p.add_argument("--max-batch", type=int,
+                   default=_env_int("IMAGINARY_TPU_MAX_BATCH", 16),
+                   help="micro-batch size cap")
+    p.add_argument("--use-mesh", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_USE_MESH"),
+                   help="shard batches over the device mesh")
+    p.add_argument("--devices", type=int,
+                   default=_env_int("IMAGINARY_TPU_DEVICES", 0),
+                   help="device count (0=all)")
+    p.add_argument("--spatial", type=int,
+                   default=_env_int("IMAGINARY_TPU_SPATIAL", 1),
                    help="spatial mesh axis size (W-shard huge images across chips)")
-    p.add_argument("--spatial-threshold-px", type=int, default=3840 * 2160,
+    p.add_argument("--spatial-threshold-px", type=int,
+                   default=_env_int("IMAGINARY_TPU_SPATIAL_THRESHOLD_PX", 3840 * 2160),
                    help="bucket pixel count at which W-sharding engages")
-    p.add_argument("--host-spill", default="auto", choices=["auto", "on", "off"],
+    p.add_argument("--host-spill",
+                   default=_env_str("IMAGINARY_TPU_HOST_SPILL", "auto"),
+                   choices=["auto", "on", "off"],
                    help="spill to host SIMD when the device link saturates "
                         "(auto = enabled, governed by the measured cost "
                         "model; spilled responses carry "
                         "X-Imaginary-Backend: host)")
     p.add_argument("--force-host", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_FORCE_HOST"),
                    help="pin every host-executable plan to the host SIMD "
                         "interpreter (measurement override; device-only "
                         "plans still ride the chip)")
@@ -236,7 +318,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max concurrent hedges as a fraction of in-flight "
                         "device items (floor 1); bounds how much duplicate "
                         "host work hedging may add under overload")
-    p.add_argument("--prewarm", action="store_true", help="pre-compile common op chains")
+    p.add_argument("--prewarm", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_PREWARM"),
+                   help="pre-compile common op chains")
     # content-addressed caching (imaginary_tpu/cache.py); every knob also
     # honors an IMAGINARY_TPU_CACHE_* env override and defaults OFF so the
     # uncached serving path stays byte-identical to the reference build
@@ -260,8 +344,12 @@ def build_parser() -> argparse.ArgumentParser:
     # observability (imaginary_tpu/obs/): tracing defaults ON (every
     # response carries X-Request-ID + Server-Timing); /debugz and wide
     # events default OFF
+    # IMAGINARY_TPU_TRACE=0 and IMAGINARY_TPU_DEBUG=1 predate the canonical
+    # flag<->env spelling and stay honored next to it (renaming a deployed
+    # env var breaks fleets for tidiness)
     p.add_argument("--disable-tracing", action="store_true",
-                   default=os.environ.get("IMAGINARY_TPU_TRACE", "").lower()
+                   default=_env_bool("IMAGINARY_TPU_DISABLE_TRACING")
+                   or os.environ.get("IMAGINARY_TPU_TRACE", "").lower()
                    in ("0", "off", "false"),
                    help="disable per-request span tracing / Server-Timing / "
                         "wide events (X-Request-ID is still assigned)")
@@ -270,17 +358,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit one structured JSON line per request "
                         "(op, plan digest, cache outcome, placement, spans)")
     p.add_argument("--enable-debug", action="store_true",
-                   default=_env_bool("IMAGINARY_TPU_DEBUG"),
+                   default=_env_bool("IMAGINARY_TPU_ENABLE_DEBUG")
+                   or _env_bool("IMAGINARY_TPU_DEBUG"),
                    help="serve /debugz runtime introspection (task dump, "
                         "executor/cache snapshots, slow-request exemplars, "
                         "one-shot profiler trigger)")
     p.add_argument("--distributed", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_DISTRIBUTED"),
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
-    p.add_argument("--coordinator-address", default="",
+    p.add_argument("--coordinator-address",
+                   default=_env_str("IMAGINARY_TPU_COORDINATOR_ADDRESS", ""),
                    help="host:port of process 0 (auto-discovered on TPU pods)")
-    p.add_argument("--num-processes", type=int, default=0,
+    p.add_argument("--num-processes", type=int,
+                   default=_env_int("IMAGINARY_TPU_NUM_PROCESSES", 0),
                    help="total process count (auto-discovered on TPU pods)")
-    p.add_argument("--process-id", type=int, default=-1,
+    p.add_argument("--process-id", type=int,
+                   default=_env_int("IMAGINARY_TPU_PROCESS_ID", -1),
                    help="this process's index (auto-discovered on TPU pods)")
     return p
 

@@ -313,6 +313,7 @@ def _backend():
 
             if native_backend.available():
                 _BACKEND = native_backend
+        # itpu: allow[ITPU004] backend ladder: a broken native build falls through to cv2/PIL
         except Exception:  # pragma: no cover
             pass
     if _BACKEND is None:
@@ -574,6 +575,7 @@ def _probe_special(buf: bytes, t: ImageType) -> Optional[ImageMetadata]:
                         w, h, t.value, "srgb", has_alpha, False,
                         4 if has_alpha else 3, 0,
                     )
+    # itpu: allow[ITPU004] metadata probing is best-effort; None means "not identifiable", not an error
     except Exception:
         pass
     return None

@@ -62,8 +62,9 @@ def decode(buf: bytes, t: ImageType, shrink: int = 1) -> DecodedImage:
             return DecodedImage(array=arr, type=t, orientation=orientation, has_alpha=False)
         except CodecError:
             raise
+        # itpu: allow[ITPU004] draft-mode decode is an optimization; the full decode below is the honest path
         except Exception:
-            pass  # fall through to the full decode
+            pass
     im = _open(buf)
     orientation = _orientation(im)
     has_alpha = im.mode in ("RGBA", "LA", "PA") or (im.mode == "P" and "transparency" in im.info)

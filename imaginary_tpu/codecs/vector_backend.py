@@ -672,6 +672,7 @@ def pdf_page_size(buf: bytes) -> Optional[tuple]:
                     return int(round(w.value)), int(round(h.value))
                 finally:
                     _pdf_close(gbytes, doc, page)
+        # itpu: allow[ITPU004] poppler page-size probe is best-effort; the MediaBox regex below is the fallback
         except Exception:
             pass
     m = re.search(

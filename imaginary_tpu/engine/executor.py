@@ -18,7 +18,7 @@ import dataclasses
 import queue as queue_mod
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Optional
 
 import numpy as np
@@ -630,7 +630,8 @@ class Executor:
         item.trace = obs_trace.current()
         _PLACEMENT.value = "device"
         if not plan.stages:  # identity chain: no device work at all
-            item.future.set_result(arr)
+            if not item.future.done():
+                item.future.set_result(arr)
             return item.future
         if self._breaker_is_open() and host_exec.can_execute(plan, for_spill=False):
             # device outage: serve from the host interpreter rather than
@@ -639,14 +640,16 @@ class Executor:
             # host can't run still go to the device (and surface its error).
             try:
                 out = host_exec.run(arr, plan)
+            # itpu: allow[ITPU004] host failover is best-effort; the device path below reports the real error
             except Exception:
-                pass  # fall through: let the device path report
+                pass
             else:
                 self.stats.breaker_host_served += 1
                 _PLACEMENT.value = "host"
                 self._stamp_attempts(
                     [item], ["device:quarantined", "host_fallback"])
-                item.future.set_result(out)
+                if not item.future.done():
+                    item.future.set_result(out)
                 return item.future
         forced = self.config.force_host and host_exec.can_execute(
             plan, for_spill=False)
@@ -708,7 +711,8 @@ class Executor:
                 self.stats.spilled += 1
                 _PLACEMENT.value = "host"
                 self._stamp_attempts([item], ["host_spill"])
-                item.future.set_result(out)
+                if not item.future.done():
+                    item.future.set_result(out)
                 return item.future
             finally:
                 self._host_release(item.mpix)
@@ -1030,7 +1034,7 @@ class Executor:
                 if exc is None:
                     try:
                         outer.set_result(f.result())
-                    except Exception:  # racing cancel; result stands down
+                    except InvalidStateError:  # racing cancel; result stands down
                         pass
                     return
                 if state["running"]:
@@ -1040,7 +1044,7 @@ class Executor:
                     return
                 try:
                     outer.set_exception(exc)
-                except Exception:
+                except InvalidStateError:  # racing cancel
                     pass
 
         def on_outer(f: Future) -> None:
@@ -1086,7 +1090,7 @@ class Executor:
                     # twin was speculative; its failure is secondary)
                     try:
                         outer.set_exception(exc)
-                    except Exception:
+                    except InvalidStateError:  # racing cancel
                         pass
         else:
             with lock:
@@ -1497,8 +1501,9 @@ class Executor:
             if host_exec.can_execute(it.plan, for_spill=False):
                 try:
                     out = host_exec.run(it.arr, it.plan)
+                # itpu: allow[ITPU004] host routing is best-effort; the error path below surfaces the device OOM
                 except Exception:
-                    pass  # fall through to the error path below
+                    pass
                 else:
                     with self._owed_lock:
                         self.stats.oom_host_routed += 1

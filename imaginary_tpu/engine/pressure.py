@@ -235,7 +235,8 @@ class MemoryGovernor:
         for cb in cbs:
             try:
                 cb(old, new)
-            except Exception:  # a broken listener must not break sampling
+            # itpu: allow[ITPU004] a broken transition listener must not break the sampling loop
+            except Exception:
                 pass
         if changed and new == LEVEL_CRITICAL:
             # entering critical: aggressively hand freed pages back to

@@ -61,6 +61,7 @@ def task_dump(limit: int = 200) -> list:
                 frames.append(
                     f"{f.f_code.co_filename}:{f.f_lineno} {f.f_code.co_name}"
                 )
+        # itpu: allow[ITPU004] best-effort diagnostic: a task completing mid-walk may refuse get_stack
         except Exception:
             pass
         out.append({

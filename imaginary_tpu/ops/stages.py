@@ -263,7 +263,6 @@ class BlurSpec:
 
         def conv1(img, k, kh, kw):
             # img [H, W, C1]; depthwise by folding channels into batch
-            c1 = img.shape[-1]
             t = jnp.transpose(img, (2, 0, 1))[..., None]  # [C1, H, W, 1]
             rhs = k.reshape(kh, kw, 1, 1)
             out = lax.conv_general_dilated(t, rhs, (1, 1), "SAME", dimension_numbers=dn)

@@ -45,7 +45,6 @@ def _conv1d(x: jnp.ndarray, kern: jnp.ndarray, axis: int) -> jnp.ndarray:
     dn = lax.conv_dimension_numbers((1, 1, 1, 1), (kh, kw, 1, 1), ("NHWC", "HWIO", "NHWC"))
 
     def one(img, k):
-        c1 = img.shape[-1]
         t = jnp.transpose(img, (2, 0, 1))[..., None]  # [C,H,W,1]
         out = lax.conv_general_dilated(t, k.reshape(kh, kw, 1, 1), (1, 1), "SAME",
                                        dimension_numbers=dn)

@@ -1,0 +1,29 @@
+"""Rule registry for itpucheck.
+
+Each rule module exposes:
+  RULE_ID  "ITPUxxx"
+  TITLE    one-line summary
+  run(index) -> iterable of (rel_path, lineno, message)
+"""
+
+from imaginary_tpu.tools.rules import (
+    async_blocking,
+    config_surface,
+    context_propagation,
+    failpoint_registry,
+    future_guard,
+    ledger,
+    metrics_exposition,
+    silent_except,
+)
+
+RULES = (
+    async_blocking,
+    future_guard,
+    ledger,
+    silent_except,
+    config_surface,
+    failpoint_registry,
+    metrics_exposition,
+    context_propagation,
+)

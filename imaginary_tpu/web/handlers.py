@@ -621,6 +621,7 @@ async def health_controller(request: web.Request, service: Optional[ImageService
     # the whole event loop — the "process alive, loop wedged" failure the
     # workers.py supervisor's liveness probe exists to catch (an async
     # sleep would only slow this one request and prove nothing)
+    # itpu: allow[ITPU001] deliberate sync block: this failpoint SIMULATES the wedged-loop failure
     failpoints.hit("worker.hang")
     return web.json_response(collect_health_stats(service))
 

@@ -255,22 +255,15 @@ class TestNativePngFeatures:
     def test_speed_changes_encode(self, testdata):
         """The speed knob must observably alter the encode (VERDICT r4
         missing #1: parsed-then-dropped)."""
-        import time
-
         arr = np.asarray(Image.open(io.BytesIO(fixture_bytes("large.jpg"))).convert("RGB"))
-        t0 = time.perf_counter()
         slow = codecs.encode(arr, EncodeOptions(type=ImageType.PNG, speed=0))
-        t_slow = time.perf_counter() - t0
-        t0 = time.perf_counter()
         fast = codecs.encode(arr, EncodeOptions(type=ImageType.PNG, speed=9))
-        t_fast = time.perf_counter() - t0
         assert slow != fast  # different filter strategy -> different bytes
         # both decode identically (lossless either way)
         assert np.array_equal(
             np.asarray(Image.open(io.BytesIO(fast)).convert("RGB")), arr)
         # timing on a shared host is noisy; size is the deterministic signal
         assert len(fast) > len(slow)  # no-filter trades size for speed
-        del t_slow, t_fast
 
 
 class TestPaletteTransparencyCollision:

@@ -48,7 +48,18 @@ _WORKER = r"""
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # cross-process collectives on the CPU backend need gloo (jax 0.4.x
+    # raises INVALID_ARGUMENT: "Multiprocess computations aren't
+    # implemented on the CPU backend" without it; newer jaxlibs pick it
+    # up automatically and may drop the option)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 from jax.sharding import PartitionSpec as P
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it in jax.experimental
+    from jax.experimental.shard_map import shard_map
 from imaginary_tpu.parallel.mesh import batch_sharding, get_mesh, init_distributed
 
 PID = {pid}
@@ -64,8 +75,8 @@ n_local = len(jax.local_devices())
 n_global = mesh.devices.shape[0] * mesh.devices.shape[1]
 x = jax.make_array_from_process_local_data(
     sharding, np.full((n_local,), float(PID + 1), np.float32), (n_global,))
-f = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "batch"),
-                          mesh=mesh, in_specs=P("batch"), out_specs=P()))
+f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "batch"),
+                      mesh=mesh, in_specs=P("batch"), out_specs=P()))
 total = float(np.asarray(f(x).addressable_shards[0].data).ravel()[0])
 expect = n_local * (1.0 + 2.0)  # each process contributes n_local shards
 assert total == expect, (total, expect)

@@ -9,12 +9,11 @@ into one direct resample — same map, equal-or-better antialiasing."""
 import json
 
 import numpy as np
-import pytest
 
 from imaginary_tpu.options import ImageOptions
 from imaginary_tpu.params import parse_json_operations
 from imaginary_tpu.ops.plan import fuse_adjacent_shrinking_samples
-from imaginary_tpu.ops.stages import BlurSpec, ExtractSpec, SampleSpec
+from imaginary_tpu.ops.stages import SampleSpec
 from imaginary_tpu.pipeline import _build_pipeline_plan
 
 

@@ -17,7 +17,6 @@ Two layers:
 
 from __future__ import annotations
 
-import io
 import json
 import os
 

@@ -1,0 +1,415 @@
+"""itpucheck: the project-invariant static analyzer (ISSUE 8).
+
+Each rule gets a fixture pair — a snippet that TRIPS it and the
+corrected spelling that doesn't — so the rule demonstrably fails
+without the check and passes with it. Plus: the suppression grammar,
+the JSON artifact schema, and the regression tripwire — the live repo
+must produce zero unsuppressed findings (a future PR reintroducing an
+unguarded set_exception or a time.sleep in an async def turns the gate
+red before review ever sees it).
+"""
+
+import json
+import os
+
+from imaginary_tpu.tools.itpucheck import (
+    default_paths,
+    main,
+    run_checks,
+    to_json,
+)
+
+
+def _scan(tmp_path, sources, rules=None, readme=""):
+    """Write {name: code} files under tmp_path, run the analyzer there."""
+    for name, code in sources.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(code)
+    if readme:
+        (tmp_path / "README.md").write_text(readme)
+    return run_checks(paths=[str(tmp_path)], root=str(tmp_path),
+                      rules=rules)
+
+
+def _rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# -- one fixture pair per rule ------------------------------------------------
+
+
+class TestAsyncBlocking:
+    def test_trips_on_sleep_and_sync_hit(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import time\n"
+            "from imaginary_tpu import failpoints\n"
+            "async def handler(request):\n"
+            "    time.sleep(1)\n"
+            "    failpoints.hit('x')\n"
+        )}, rules=["ITPU001"])
+        assert [f.line for f in findings] == [4, 5]
+        assert _rules_hit(findings) == {"ITPU001"}
+
+    def test_clean_async_and_sync_sleep_pass(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import asyncio, time\n"
+            "from imaginary_tpu import failpoints\n"
+            "async def handler(request):\n"
+            "    await asyncio.sleep(1)\n"
+            "    await failpoints.ahit('x')\n"
+            "def sync_worker():\n"
+            "    time.sleep(1)  # fine: not on the event loop\n"
+            "async def offloaded():\n"
+            "    def work():\n"
+            "        time.sleep(1)  # nested def runs on a pool thread\n"
+            "    return work\n"
+        )}, rules=["ITPU001"])
+        assert findings == []
+
+
+class TestFutureGuard:
+    def test_trips_unguarded(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def resolve(fut, out):\n"
+            "    fut.set_result(out)\n"
+            "def fail(fut, e):\n"
+            "    fut.set_exception(e)\n"
+        )}, rules=["ITPU002"])
+        assert [f.line for f in findings] == [2, 4]
+
+    def test_done_guard_and_try_pass(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "from concurrent.futures import InvalidStateError\n"
+            "def resolve(fut, out):\n"
+            "    if not fut.done():\n"
+            "        fut.set_result(out)\n"
+            "def fail(fut, e):\n"
+            "    try:\n"
+            "        fut.set_exception(e)\n"
+            "    except InvalidStateError:\n"
+            "        pass\n"
+        )}, rules=["ITPU002"])
+        assert findings == []
+
+    def test_guard_does_not_cross_function_boundary(self, tmp_path):
+        # a done() check in the OUTER function must not bless a nested
+        # callback's unguarded resolution
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def outer(fut):\n"
+            "    if not fut.done():\n"
+            "        def cb(f):\n"
+            "            fut.set_result(1)\n"
+            "        return cb\n"
+        )}, rules=["ITPU002"])
+        assert [f.line for f in findings] == [4]
+
+
+class TestLedger:
+    def test_trips_charge_without_finally(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item):\n"
+            "        self._host_charge(item.mpix)\n"
+            "        out = self.run(item)\n"
+            "        self._host_release(item.mpix)\n"  # not in a finally
+            "        return out\n"
+        )}, rules=["ITPU003"])
+        assert [f.line for f in findings] == [3]
+
+    def test_finally_release_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item):\n"
+            "        self._host_charge(item.mpix)\n"
+            "        try:\n"
+            "            return self.run(item)\n"
+            "        finally:\n"
+            "            self._host_release(item.mpix)\n"
+        )}, rules=["ITPU003"])
+        assert findings == []
+
+    def test_trips_owed_charge_without_cancel(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item):\n"
+            "        self._charge_owed(item)\n"
+            "        self._queue.put(item)\n"  # a raising put leaks
+            "        return item.future\n"
+        )}, rules=["ITPU003"])
+        assert [f.line for f in findings] == [3]
+
+    def test_cancel_on_enqueue_failure_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item):\n"
+            "        self._charge_owed(item)\n"
+            "        try:\n"
+            "            self._queue.put(item)\n"
+            "        except Exception:\n"
+            "            item.future.cancel()\n"
+            "            raise\n"
+            "        return item.future\n"
+        )}, rules=["ITPU003"])
+        assert findings == []
+
+
+class TestSilentExcept:
+    def test_trips_both_shapes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "def h():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        return None\n"
+        )}, rules=["ITPU004"])
+        assert [f.line for f in findings] == [4, 9]
+
+    def test_narrow_or_handled_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except ValueError:\n"
+            "        pass\n"  # narrowed: fine
+            "    try:\n"
+            "        g()\n"
+            "    except Exception as e:\n"
+            "        log(e)\n"  # handled: fine
+        )}, rules=["ITPU004"])
+        assert findings == []
+
+
+class TestConfigSurface:
+    def test_trips_missing_env_and_readme(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"cli.py": (
+            "import argparse, os\n"
+            "p = argparse.ArgumentParser()\n"
+            "p.add_argument('--shiny-knob', default='')\n"
+            "SECRET = os.environ.get('IMAGINARY_TPU_UNDOCUMENTED', '')\n"
+        )}, rules=["ITPU005"], readme="# docs\nnothing relevant\n")
+        msgs = "\n".join(f.message for f in findings)
+        assert "IMAGINARY_TPU_SHINY_KNOB" in msgs       # env default missing
+        assert "--shiny-knob" in msgs                   # README mention missing
+        assert "IMAGINARY_TPU_UNDOCUMENTED" in msgs     # env not in README
+        assert len(findings) == 3
+
+    def test_consistent_surface_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"cli.py": (
+            "import argparse, os\n"
+            "p = argparse.ArgumentParser()\n"
+            "p.add_argument('--shiny-knob',\n"
+            "               default=os.environ.get('IMAGINARY_TPU_SHINY_KNOB', ''))\n"
+        )}, rules=["ITPU005"],
+            readme="`--shiny-knob` / `IMAGINARY_TPU_SHINY_KNOB`\n")
+        assert findings == []
+
+
+class TestFailpointRegistry:
+    _REGISTRY = "SITES = (\n    'source.fetch',\n    'codec.decode',\n)\n"
+
+    def test_trips_unknown_and_unused(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "failpoints.py": self._REGISTRY,
+            "m.py": (
+                "from imaginary_tpu import failpoints\n"
+                "def f():\n"
+                "    failpoints.hit('source.fetch')\n"
+                "    failpoints.hit('typo.site')\n"
+            ),
+        }, rules=["ITPU006"])
+        msgs = "\n".join(f.message for f in findings)
+        assert "typo.site" in msgs          # used but undeclared
+        assert "codec.decode" in msgs       # declared but never hit
+        assert len(findings) == 2
+
+    def test_registry_in_sync_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "failpoints.py": self._REGISTRY,
+            "m.py": (
+                "from imaginary_tpu import failpoints\n"
+                "async def f():\n"
+                "    await failpoints.ahit('source.fetch')\n"
+                "def g():\n"
+                "    failpoints.hit('codec.decode')\n"
+            ),
+        }, rules=["ITPU006"])
+        assert findings == []
+
+
+class TestMetricsExposition:
+    def test_trips_all_three_contracts(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"web/metrics.py": (
+            "def render(x, v):\n"
+            "    x.emit('myapp_requests', v, help_text='h')\n"
+            "    x.emit('imaginary_tpu_errors', v, mtype='counter',\n"
+            "           help_text='h')\n"
+            "    x.emit('imaginary_tpu_depth', v)\n"
+        )}, rules=["ITPU007"])
+        msgs = "\n".join(f.message for f in findings)
+        assert "namespace" in msgs          # myapp_ prefix
+        assert "_total" in msgs             # counter naming
+        assert "help_text" in msgs          # HELP line
+        assert len(findings) == 3
+
+    def test_strict_families_pass(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"web/metrics.py": (
+            "def render(x, v, k):\n"
+            "    x.emit('imaginary_tpu_errors_total', v, mtype='counter',\n"
+            "           help_text='Errors.')\n"
+            "    x.emit('imaginary_tpu_depth', v, help_text='Depth.')\n"
+            "    x.emit(f'imaginary_tpu_exec_{k}', v, mtype=k,\n"
+            "           help_text='Dynamic family.')\n"
+        )}, rules=["ITPU007"])
+        assert findings == []
+
+
+class TestContextPropagation:
+    def test_trips_bare_pool_submit_and_run_in_executor(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "async def handle(self, loop, work):\n"
+            "    fut = self.pool.submit(work, 1)\n"
+            "    await loop.run_in_executor(None, work)\n"
+        )}, rules=["ITPU008"])
+        assert [f.line for f in findings] == [2, 3]
+
+    def test_copy_context_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import contextvars\n"
+            "async def handle(self, loop, work):\n"
+            "    ctx = contextvars.copy_context()\n"
+            "    fut = self.pool.submit(ctx.run, work, 1)\n"
+            "    await loop.run_in_executor(None, ctx.run, work)\n"
+            "    self.executor.submit(work, 1)  # micro-batch executor, not a pool\n"
+        )}, rules=["ITPU008"])
+        assert findings == []
+
+
+# -- suppression grammar ------------------------------------------------------
+
+
+class TestSuppression:
+    _CODE = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # itpu: allow[ITPU001] measured: must block here\n"
+    )
+
+    def test_same_line_suppression(self, tmp_path):
+        findings, suppressed = _scan(tmp_path, {"m.py": self._CODE},
+                                     rules=["ITPU001"])
+        assert findings == []
+        assert len(suppressed) == 1
+        assert suppressed[0].reason == "measured: must block here"
+
+    def test_standalone_comment_covers_next_code_line(self, tmp_path):
+        findings, suppressed = _scan(tmp_path, {"m.py": (
+            "import time\n"
+            "async def f():\n"
+            "    # itpu: allow[ITPU001] deliberate wedge simulation\n"
+            "    time.sleep(1)\n"
+        )}, rules=["ITPU001"])
+        assert findings == []
+        assert len(suppressed) == 1
+
+    def test_reasonless_suppression_is_a_finding(self, tmp_path):
+        findings, suppressed = _scan(tmp_path, {"m.py": (
+            "import time\n"
+            "async def f():\n"
+            "    time.sleep(1)  # itpu: allow[ITPU001]\n"
+        )}, rules=["ITPU001"])
+        # the blanket suppression does NOT suppress, and is itself flagged
+        rules = sorted(f.rule for f in findings)
+        assert rules == ["ITPU000", "ITPU001"]
+        assert suppressed == []
+
+    def test_wrong_rule_id_does_not_suppress(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import time\n"
+            "async def f():\n"
+            "    time.sleep(1)  # itpu: allow[ITPU004] wrong rule named\n"
+        )}, rules=["ITPU001"])
+        assert {f.rule for f in findings} == {"ITPU001"}
+
+    def test_unknown_rule_id_is_a_finding(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "x = 1  # itpu: allow[BOGUS123] whatever\n"
+        )})
+        assert any(f.rule == "ITPU000" and "BOGUS123" in f.message
+                   for f in findings)
+
+
+# -- output surfaces ----------------------------------------------------------
+
+
+class TestJsonOutput:
+    def test_schema(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import time\nasync def f():\n    time.sleep(1)\n")
+        out = tmp_path / "artifacts" / "itpucheck.json"
+        rc = main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                   "--json", str(out), "-q"])
+        assert rc == 1
+        doc = json.loads(out.read_text())
+        assert doc["tool"] == "itpucheck"
+        assert doc["version"] == 1
+        assert set(doc["counts"]) == {"findings", "suppressed", "per_rule"}
+        assert doc["counts"]["findings"] == len(doc["findings"]) == 1
+        f = doc["findings"][0]
+        assert set(f) == {"rule", "path", "line", "message"}
+        assert f["rule"] == "ITPU001" and f["line"] == 3
+        # all 8 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 8
+
+    def test_to_json_counts_suppressed(self, tmp_path):
+        findings, suppressed = _scan(tmp_path, {"m.py": (
+            "import time\n"
+            "async def f():\n"
+            "    time.sleep(1)  # itpu: allow[ITPU001] fixture\n"
+        )}, rules=["ITPU001"])
+        doc = to_json(findings, suppressed)
+        assert doc["counts"]["suppressed"] == 1
+        assert doc["suppressed_findings"][0]["reason"] == "fixture"
+
+    def test_exit_zero_and_artifact_on_clean_tree(self, tmp_path):
+        (tmp_path / "m.py").write_text("x = 1\n")
+        out = tmp_path / "r.json"
+        rc = main([str(tmp_path / "m.py"), "--root", str(tmp_path),
+                   "--json", str(out), "-q"])
+        assert rc == 0
+        assert json.loads(out.read_text())["counts"]["findings"] == 0
+
+
+class TestSyntaxError:
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        (tmp_path / "m.py").write_text("def broken(:\n")
+        findings, _ = run_checks(paths=[str(tmp_path)], root=str(tmp_path))
+        assert [f.rule for f in findings] == ["ITPU000"]
+        assert "syntax error" in findings[0].message
+
+
+# -- the regression tripwire --------------------------------------------------
+
+
+class TestLiveTree:
+    def test_live_tree_is_clean(self):
+        """The shipped package has an EMPTY baseline: zero unsuppressed
+        findings. Reintroducing any encoded bug class — an unguarded
+        set_exception, a time.sleep in an async def, a leaking ledger
+        charge, an off-registry failpoint — fails here (and `make
+        check`) immediately."""
+        findings, suppressed = run_checks()
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+        # every in-tree suppression carries a reason (ITPU000 enforces
+        # this, but pin it explicitly: it is the review contract)
+        assert all(f.reason for f in suppressed)
+
+    def test_default_scan_covers_the_package(self):
+        paths, root = default_paths()
+        assert os.path.basename(paths[0]) == "imaginary_tpu"
+        assert os.path.isfile(os.path.join(root, "README.md"))

@@ -6,7 +6,6 @@ autorotate behavior, now matched by the byte-splice carry in pipeline."""
 from io import BytesIO
 
 import numpy as np
-import pytest
 from PIL import Image
 
 from imaginary_tpu import codecs, pipeline

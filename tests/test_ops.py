@@ -370,7 +370,6 @@ class TestOutputBucketTightening:
 
     def test_final_sample_stage_retargeted(self):
         from imaginary_tpu.ops.plan import plan_operation
-        from imaginary_tpu.ops.stages import SampleSpec
 
         plan = plan_operation("resize", ImageOptions(width=300, height=200), 1080, 1920, 0, 3)
         last_shape = [s.spec for s in plan.stages if hasattr(s.spec, "out_hb")][-1]
