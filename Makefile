@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory chaos serve clean gate lint check
+.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory bench-device chaos serve clean gate lint check
 
 all: native test
 
@@ -23,7 +23,9 @@ gate: lint test chaos
 	  { echo "bench_qos.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=4 BENCH_CONCURRENCY=6 python bench_memory.py || \
 	  { echo "bench_memory.py failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory benches all pass"
+	BENCH_DURATION=4 BENCH_THREADS=8 BENCH_AB=1 BENCH_PLATFORM=cpu python bench_device.py || \
+	  { echo "bench_device.py policy A/B failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device benches all pass"
 
 # Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7): the deadline/failpoint/
 # devhealth/pressure suites, then four soaks — a flaky-origin row
@@ -96,6 +98,13 @@ bench-deadline:
 # to improve the interactive p99 or breaches the isolation bound
 bench-qos:
 	python bench_qos.py
+
+# forced-device batch-policy A/B (convoy vs continuous) on this host's
+# backend: exits nonzero when the continuous policy's batch_form +
+# dispatch_wait p50 exceeds 25% of the convoy queue_wait p50, when
+# throughput regresses, or when any arm pays a post-prewarm compile
+bench-device:
+	BENCH_AB=1 BENCH_PLATFORM=cpu python bench_device.py
 
 # bomb + oversize-enlarge firehose, governor on vs off: the governed arm
 # must hold >=95% well-formed availability (only 200/413/503/504) with

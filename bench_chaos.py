@@ -161,8 +161,28 @@ async def _chip_loss_soak(duration: float, concurrency: int) -> dict:
             print(f"[chaos] chip-loss: arming {spec!r} "
                   f"({len(ex.devhealth)} device(s))", file=sys.stderr)
             failpoints.activate(spec)
-            await drive(max(duration / 2, 2.0))
-            mid = ex.devhealth.snapshot()
+            # Sample the registry DURING the fault, not once at its end:
+            # the bench-shortened cooldown (1.5 s) can expire inside the
+            # fault window — the sick chip then reads half_open until the
+            # next probe re-strikes it, and a single end-of-phase snapshot
+            # races that probe cycle (measured flaking once the continuous
+            # collector started tripping the quarantine earlier in the
+            # phase). The invariant is "at some point the sick chip was
+            # quarantined ALONE while a healthy peer served", which only a
+            # running sampler can observe race-free.
+            mid = {"quarantined": 0, "healthy": 0}
+            fault_s = max(duration / 2, 2.0)
+
+            async def sample(deadline: float) -> None:
+                while time.monotonic() < deadline:
+                    s = ex.devhealth.snapshot()
+                    if s["quarantined"] == 1:
+                        mid["quarantined"] = 1
+                        mid["healthy"] = max(mid["healthy"], s["healthy"])
+                    await asyncio.sleep(0.05)
+
+            await asyncio.gather(drive(fault_s),
+                                 sample(time.monotonic() + fault_s))
             failpoints.deactivate()
             # phase 3: fault cleared — probe (multi) or half-open request
             # (single) must re-admit the device

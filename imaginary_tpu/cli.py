@@ -276,10 +276,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "the rest serve on the host backend")
     p.add_argument("--batch-window-ms", type=float,
                    default=_env_float("IMAGINARY_TPU_BATCH_WINDOW_MS", 3.0),
-                   help="micro-batch window")
+                   help="micro-batch window (convoy policy only)")
     p.add_argument("--max-batch", type=int,
                    default=_env_int("IMAGINARY_TPU_MAX_BATCH", 16),
                    help="micro-batch size cap")
+    # continuous batching (engine/executor.py): formation capped at
+    # single-digit ms, chunks launch immediately and overlap in flight;
+    # "convoy" keeps the legacy accumulate-launch-drain policy for A/B
+    p.add_argument("--batch-policy",
+                   default=_env_str("IMAGINARY_TPU_BATCH_POLICY", "continuous"),
+                   choices=["continuous", "convoy"],
+                   help="batch formation policy: continuous admits "
+                        "arrivals into the next in-flight chunk "
+                        "(formation capped at --batch-form-ms); convoy is "
+                        "the legacy accumulate-until-the-link-idles policy")
+    p.add_argument("--batch-form-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_BATCH_FORM_MS", 5.0),
+                   help="continuous policy: max milliseconds an item may "
+                        "wait for its chunk to close (the batch-formation "
+                        "latency cap)")
+    p.add_argument("--max-inflight", type=int,
+                   default=_env_int("IMAGINARY_TPU_MAX_INFLIGHT", 4),
+                   help="device groups launched but not yet fetched (the "
+                        "H2D/compute/D2H double-buffer depth; backpressure "
+                        "beyond it)")
+    p.add_argument("--donation",
+                   default=_env_str("IMAGINARY_TPU_DONATION", "on"),
+                   choices=["on", "off"],
+                   help="donate the batch operand to XLA (donate_argnums) "
+                        "so input HBM is reused for outputs; a backend "
+                        "that rejects donation falls back undonated and "
+                        "latches it off")
     p.add_argument("--use-mesh", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_USE_MESH"),
                    help="shard batches over the device mesh")
@@ -469,6 +496,10 @@ def options_from_args(args) -> ServerOptions:
         pressure_pixel_frac=min(1.0, max(0.01, args.pressure_pixel_frac)),
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
+        batch_policy=args.batch_policy,
+        batch_form_ms=max(0.0, args.batch_form_ms),
+        max_inflight=max(1, args.max_inflight),
+        donation=args.donation != "off",
         use_mesh=args.use_mesh,
         n_devices=args.devices or None,
         spatial=max(1, args.spatial),
@@ -591,8 +622,12 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", "cpu")
 
     if o.prewarm:
+        from imaginary_tpu.ops import chain as chain_mod
         from imaginary_tpu.prewarm import prewarm_common_chains
 
+        # the donate flag is part of the compile-cache key: prewarm must
+        # agree with the serving executor or every warm would miss
+        chain_mod.set_donation(o.donation)
         prewarm_common_chains()
     try:
         asyncio.run(serve(o, mrelease=args.mrelease))

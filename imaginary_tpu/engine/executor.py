@@ -6,10 +6,26 @@ threads/tasks; a collector thread groups items that share a chain signature
 batched device call — optionally sharded over the mesh's batch axis.
 
 Batch formation policy (SURVEY.md section 7 hard-part #2, latency vs
-throughput): a group dispatches when it reaches `max_batch` items or when its
-oldest item has waited `window_ms`. Under light load the window bounds added
-latency; under heavy load batches fill instantly and the window never
-matters.
+throughput) — two policies, `batch_policy`:
+
+  * "continuous" (the default): a chunk closes the moment it reaches
+    `max_batch` items or its oldest item has waited the formation cap
+    (`max_form_ms`, single-digit milliseconds), and launches immediately —
+    newly arrived items ride the NEXT in-flight chunk instead of waiting
+    for the current drain. The link and the chip overlap naturally: the
+    collector stages H2D for chunk N+1 (launch_batch's async device_put)
+    while N computes and the fetcher reads back N-1; the bounded fetch
+    queue (`max_inflight`) is the only backpressure.
+  * "convoy" (the pre-r13 policy, kept for A/B measurement —
+    bench_device.py's policy row): accumulate up to `max_group` items,
+    dispatching only when the window expires AND the D2H link is idle, or
+    at the `max_hold_ms` age cap. Amortizes the link's fixed drain cost
+    over huge groups at the price of queue_wait convoys — BENCH_r03
+    measured 172 ms p50 of queue_wait at avg_batch 10.3 on the real TPU.
+
+Either way each item's wait splits into `batch_form` (submit -> chunk
+close, bounded by the formation cap) and `dispatch_wait` (chunk close ->
+launch, i.e. time behind in-flight chunks); `queue_wait` remains their sum.
 """
 
 from __future__ import annotations
@@ -61,9 +77,19 @@ def batch_ladder(max_batch: int = MAX_BATCH) -> tuple:
 class ExecutorConfig:
     window_ms: float = 3.0
     max_batch: int = MAX_BATCH  # device-call chunk size (the jit batch-shape ladder tops out here)
-    max_group: int = 64  # accumulation cap: one fetch drains up to this many images
-    max_hold_ms: float = 250.0  # hard age cap: dispatch a group this old even if the link is busy
+    max_group: int = 64  # convoy policy: one fetch drains up to this many images
+    max_hold_ms: float = 250.0  # convoy policy: hard age cap even if the link is busy
     max_inflight: int = 4  # groups launched but not yet fetched
+    # Batch formation policy (module docstring): "continuous" admits
+    # arrivals into the next in-flight chunk with formation delay capped
+    # at max_form_ms; "convoy" is the legacy accumulate-launch-drain
+    # policy, kept for A/B measurement (bench_device.py asserts the
+    # continuous policy beats it on queue_wait without losing throughput).
+    batch_policy: str = "continuous"
+    # Continuous-policy formation cap in ms. None derives it from
+    # window_ms (tests and embedders that tuned window_ms keep their
+    # batching semantics); the CLI default is 5 ms (--batch-form-ms).
+    max_form_ms: Optional[float] = None
     use_mesh: bool = False  # shard micro-batches over the device mesh
     n_devices: Optional[int] = None  # None = all devices
     spatial: int = 1  # spatial mesh axis size (sp sharding for huge images)
@@ -197,6 +223,11 @@ class ExecutorStats:
     max_group_seen: int = 0
     queue_depth: int = 0
     compile_cache_size: int = 0
+    # Dispatches that paid a post-boot XLA compile (the cold-drain
+    # detector's count). With --prewarm covering the full (chain, bucket,
+    # batch-rung) matrix this must stay 0 — bench_device.py asserts it,
+    # turning "no request ever pays a compile" into a tested invariant.
+    compile_misses: int = 0
     spilled: int = 0
     spill_errors: int = 0  # host-spill attempts that fell back to the device
     spatial_batches: int = 0  # device calls that W-sharded over the mesh
@@ -227,7 +258,11 @@ class ExecutorStats:
         # per-stage spill timing rides along so the p99 tail is
         # attributable from /health alone (the admission gate and the
         # bench both read this dict)
-        spill_times = TIMES.snapshot().get("host_spill")
+        snap = TIMES.snapshot()
+        spill_times = snap.get("host_spill")
+        form_times = snap.get("batch_form")
+        disp_times = snap.get("dispatch_wait")
+        donation = chain_mod.donation_stats()
         return {
             "items": self.items,
             "batches": self.batches,
@@ -237,6 +272,16 @@ class ExecutorStats:
             "max_group": self.max_group_seen,
             "queue_depth": self.queue_depth,
             "compile_cache_size": chain_mod.cache_size(),
+            "compile_misses": self.compile_misses,
+            # the queue_wait split (engine/timing.py): which half convoys —
+            # formation (the policy holding chunks open) or dispatch (time
+            # behind in-flight chunks) — readable from /health alone
+            "batch_form_p50_ms": form_times["p50_ms"] if form_times else 0.0,
+            "batch_form_p99_ms": form_times["p99_ms"] if form_times else 0.0,
+            "dispatch_wait_p50_ms": disp_times["p50_ms"] if disp_times else 0.0,
+            "dispatch_wait_p99_ms": disp_times["p99_ms"] if disp_times else 0.0,
+            "donation_enabled": donation["enabled"],
+            "donation_rejected": donation["rejected"],
             "spilled": self.spilled,
             "spill_errors": self.spill_errors,
             "spatial_batches": self.spatial_batches,
@@ -328,8 +373,8 @@ def last_placement() -> Optional[str]:
 
 
 class _Item:
-    __slots__ = ("arr", "plan", "future", "key", "t", "wire_mb", "mpix",
-                 "qos", "trace")
+    __slots__ = ("arr", "plan", "future", "key", "t", "t_close", "wire_mb",
+                 "mpix", "qos", "trace")
 
     def __init__(self, arr: np.ndarray, plan: ImagePlan):
         self.arr = arr
@@ -365,6 +410,9 @@ class _Item:
         self.wire_mb = (hb * wb * arr.shape[2] + out_bytes) / 1e6
         self.mpix = in_h * in_w / 1e6
         self.t = time.monotonic()
+        # Stamped by the collector when this item's chunk closes; the
+        # batch_form / dispatch_wait stage split reads it (_dispatch).
+        self.t_close = self.t
 
 
 class Executor:
@@ -583,6 +631,8 @@ class Executor:
         consec = self._consec_device_failures
         snap = {
             "queue_depth": self.stats.queue_depth,
+            "batch_policy": self.config.batch_policy,
+            "batch_form_cap_ms": round(self._form_cap_s() * 1000.0, 3),
             "inflight_groups": inflight_groups,
             "drain_in_flight_age_s": drain_age_s,
             "fetcher_generation": fetch_gen,
@@ -1141,8 +1191,87 @@ class Executor:
 
     # -- collector -------------------------------------------------------------
 
+    def _form_cap_s(self) -> float:
+        """Continuous policy's formation cap in seconds: max_form_ms when
+        set, else window_ms — embedders (and this repo's own tests) that
+        tuned window_ms keep the batching semantics they tuned for."""
+        ms = self.config.max_form_ms
+        if ms is None:
+            ms = self.config.window_ms
+        return max(ms, 0.0) / 1000.0
+
     def _collector(self):
-        """Batch formation policy (SURVEY.md section 7 hard-part #2).
+        if self.config.batch_policy == "convoy":
+            self._collect_convoy()
+        else:
+            self._collect_continuous()
+
+    def _collect_continuous(self):
+        """Continuous batching (module docstring): a chunk closes at
+        max_batch items or at the formation cap, whichever first, and
+        launches IMMEDIATELY — never gated on the link being idle, never
+        held for a bigger drain. An item that arrives while chunks are in
+        flight forms the next chunk and overlaps them (H2D of N+1 under
+        compute of N under D2H of N-1); the bounded fetch queue is the only
+        backpressure, and time spent blocked on it books as dispatch_wait
+        for the items it delays, not as formation."""
+        form = self._form_cap_s()
+        pending: dict = {}  # key -> list[_Item]
+        while self._running:
+            timeout = None
+            if pending:
+                oldest = min(items[0].t for items in pending.values())
+                timeout = max(0.0, oldest + form - time.monotonic())
+            try:
+                got = self._queue.get(timeout=timeout)
+                if got is None:
+                    break
+                pending.setdefault(got.key, []).append(got)
+            except queue_mod.Empty:
+                pass
+            else:
+                # drain the backlog before deciding what's due (same
+                # reasoning as the convoy collector: one-item wakeups
+                # would dispatch singletons under load)
+                while True:
+                    try:
+                        more = self._queue.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if more is None:
+                        self._running = False
+                        break
+                    pending.setdefault(more.key, []).append(more)
+            now = time.monotonic()
+            due = [
+                k for k, items in pending.items()
+                if len(items) >= self.config.max_batch
+                or now - items[0].t >= form
+            ]
+            for k in due:
+                items = pending.pop(k)
+                for start in range(0, len(items), self.config.max_batch):
+                    self._close_chunk(items[start: start + self.config.max_batch],
+                                      form)
+            self.stats.queue_depth = self._queue.qsize() + sum(len(v) for v in pending.values())
+        for items in pending.values():
+            self._close_chunk(items, form)
+        self._fetch_queue.put(None)
+
+    def _close_chunk(self, items: list, form_cap_s: float) -> None:
+        """Stamp the formation/dispatch boundary and launch. An item's
+        chunk CLOSES no later than its submit time + the formation cap —
+        if the collector popped it later than that (it was stuck in the
+        intake queue behind a blocking fetch-queue put), the excess is
+        time behind in-flight chunks and must book as dispatch_wait, not
+        as formation the policy never asked for."""
+        now = time.monotonic()
+        for it in items:
+            it.t_close = min(now, it.t + form_cap_s)
+        self._dispatch(items)
+
+    def _collect_convoy(self):
+        """Legacy accumulate-launch-drain policy (kept for A/B rows).
 
         A group dispatches when ANY of:
           - it reached max_group (one full drain's worth), or
@@ -1151,7 +1280,7 @@ class Executor:
             while under load it keeps accumulating instead of wasting a
             fixed-cost readback on a near-empty batch, or
           - its oldest item is older than max_hold_ms (starvation guard for
-            a trickling chain key while another key saturates the link).
+          a trickling chain key while another key saturates the link).
         """
         window = self.config.window_ms / 1000.0
         hold = self.config.max_hold_ms / 1000.0
@@ -1200,11 +1329,14 @@ class Executor:
             for k in due:
                 items = pending.pop(k)
                 for start in range(0, len(items), self.config.max_group):
-                    self._dispatch(items[start : start + self.config.max_group])
+                    # a convoy chunk stays OPEN until dispatch (that is the
+                    # policy), so its whole wait is formation time: no cap
+                    self._close_chunk(items[start : start + self.config.max_group],
+                                      float("inf"))
             self.stats.queue_depth = self._queue.qsize() + sum(len(v) for v in pending.values())
         # drain on shutdown, then release the fetcher
         for items in pending.values():
-            self._dispatch(items)
+            self._close_chunk(items, float("inf"))
         self._fetch_queue.put(None)
 
     def _launch_chunk(self, items: list, device=None):
@@ -1370,7 +1502,12 @@ class Executor:
         chunks = []
         now = time.monotonic()
         for it in items:
+            # the queue_wait split (engine/timing.py): formation delay up
+            # to the chunk close the collector stamped, everything after
+            # that — time behind in-flight chunks — as dispatch_wait
             TIMES.record("queue_wait", (now - it.t) * 1000.0)
+            TIMES.record("batch_form", (it.t_close - it.t) * 1000.0)
+            TIMES.record("dispatch_wait", (now - it.t_close) * 1000.0)
         cache_before = chain_mod.cache_size()
         try:
             # chaos site: delay() models a slow device/link (the collector
@@ -1399,6 +1536,10 @@ class Executor:
         # divided over one group would lock thousands of requests into host
         # spill before the EWMA recovered — ADVICE r1).
         cold = chain_mod.cache_size() > cache_before
+        if cold:
+            # a real request paid a post-boot XLA compile: prewarm missed
+            # this (chain, bucket, batch-rung) — bench_device pins this at 0
+            self.stats.compile_misses += 1
         self.stats.items += launched
         self.stats.groups += 1
         self.stats.batches += len(chunks)
@@ -1541,11 +1682,11 @@ class Executor:
                     or time.monotonic() - state[0] < budget
                 ):
                     continue
-                _, chunks, _ = state
+                _, chunks, _, n_groups = state
                 self._drain_state = None
                 self._fetch_gen += 1
                 gen = self._fetch_gen
-                self._inflight -= 1
+                self._inflight -= n_groups
             err = RuntimeError(
                 f"device drain exceeded {budget:.0f}s watchdog; "
                 "link presumed hung"
@@ -1600,12 +1741,32 @@ class Executor:
                 # queue) and exit
                 self._fetch_queue.put(got)
                 return
-            chunks, cold = got
+            # Opportunistic drain coalescing: every group queued behind
+            # this one is ALREADY launched (H2D + compute in flight), so
+            # reading them all back with one parallel device_get amortizes
+            # the link's fixed D2H cost over everything in flight. This is
+            # what lets the continuous policy launch chunk-sized groups
+            # without giving back the convoy policy's drain amortization:
+            # small launches, big drains.
+            groups = [got]
+            sentinel = False
+            while True:
+                try:
+                    more = self._fetch_queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if more is None:
+                    sentinel = True
+                    break
+                groups.append(more)
+            chunks = [c for g in groups for c in g[0]]
+            cold = any(g[1] for g in groups)
+            n_groups = len(groups)
             n_items = sum(len(c[3]) for c in chunks)
             t0 = time.monotonic()
             t_ready = None
             with self._inflight_lock:
-                self._drain_state = (t0, chunks, gen)
+                self._drain_state = (t0, chunks, gen, n_groups)
             try:
                 if self.config.split_drain_timing:
                     # diagnostic mode: sync compute first so the H2D+compute
@@ -1633,7 +1794,9 @@ class Executor:
                                    and cidx < len(self._devices)) else None)
                         self._recover_oom_chunk(c[3], dev, cidx, e)
                     with self._inflight_lock:
-                        self._inflight -= 1
+                        self._inflight -= n_groups
+                    if sentinel:
+                        break
                     continue
                 # a failed drain strikes every fault domain it rode (one
                 # EVENT per device; for one device this is the PR 4 "one
@@ -1648,7 +1811,9 @@ class Executor:
                         if not it.future.done():
                             it.future.set_exception(e)
                 with self._inflight_lock:
-                    self._inflight -= 1
+                    self._inflight -= n_groups
+                if sentinel:
+                    break
                 continue
             with self._inflight_lock:
                 live = self._fetch_gen == gen
@@ -1709,21 +1874,26 @@ class Executor:
                 g = per_mb if prev is None else min(per_mb, 4.0 * prev)
                 self._device_ms_per_mb = g if prev is None else 0.7 * prev + 0.3 * g
                 self.stats.device_ms_per_mb = self._device_ms_per_mb
-                key = chunks[0][3][0].key  # groups are single-key
-                with self._owed_lock:
-                    kprev = self._rate_by_key.get(key)
-                    if kprev is None and len(self._rate_by_key) >= 256:
-                        self._rate_by_key.clear()  # bounded; re-learns fast
-                    if kprev is None:
-                        # seed clamped against the global so one GC-paused
-                        # first drain can't pin a fresh key sky-high (the
-                        # 8x-global cap in _rate_for bounds the damage, but
-                        # a sane seed converges instead of saturating)
-                        k = per_mb if prev is None else min(per_mb, 16.0 * prev)
-                        self._rate_by_key[key] = k
-                    else:
-                        k = min(per_mb, 4.0 * kprev)
-                        self._rate_by_key[key] = 0.7 * kprev + 0.3 * k
+                # launched groups are single-key, but a coalesced drain may
+                # span keys — per-key refinement only books when the whole
+                # drain priced one chain (the global EWMA books regardless)
+                keys = {c[3][0].key for c in chunks}
+                if len(keys) == 1:
+                    key = keys.pop()
+                    with self._owed_lock:
+                        kprev = self._rate_by_key.get(key)
+                        if kprev is None and len(self._rate_by_key) >= 256:
+                            self._rate_by_key.clear()  # bounded; re-learns fast
+                        if kprev is None:
+                            # seed clamped against the global so one GC-paused
+                            # first drain can't pin a fresh key sky-high (the
+                            # 8x-global cap in _rate_for bounds the damage, but
+                            # a sane seed converges instead of saturating)
+                            k = per_mb if prev is None else min(per_mb, 16.0 * prev)
+                            self._rate_by_key[key] = k
+                        else:
+                            k = min(per_mb, 4.0 * kprev)
+                            self._rate_by_key[key] = 0.7 * kprev + 0.3 * k
             for host_y, (y, arrs, plans, sub, _idx) in zip(fetched, chunks):
                 try:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)
@@ -1736,7 +1906,9 @@ class Executor:
                     if not it.future.done():  # watchdog may have failed it
                         it.future.set_result(out)
             with self._inflight_lock:
-                self._inflight -= 1
+                self._inflight -= n_groups
+            if sentinel:
+                break
 
 
 _DEFAULT: Optional[Executor] = None

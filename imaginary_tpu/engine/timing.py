@@ -26,7 +26,9 @@ _RING = 2048  # samples kept per stage for percentile estimates
 STAGES = (
     "probe",        # header-only metadata parse
     "decode",       # host codec decode (incl. shrink-on-load)
-    "queue_wait",   # submit -> device-call launch
+    "queue_wait",   # submit -> device-call launch (batch_form + dispatch_wait)
+    "batch_form",   # submit -> chunk close (bounded by the formation cap)
+    "dispatch_wait",  # chunk close -> launch issued (behind in-flight chunks)
     "drain",        # fetch start -> host bytes landed (one sync, amortized/item)
     "device_wait",  # split mode only: fetch start -> outputs ready (H2D + compute)
     "d2h",          # split mode only: device->host readback (amortized/item)

@@ -9,8 +9,9 @@ middleware creates/activates the trace; `contextvars.copy_context()`
 carries it into the host worker pool, so spans recorded on the worker
 thread (decode/encode/host_spill via engine/timing.py's stage hook)
 attribute to the right request. Stages recorded on the executor's own
-collector/fetcher threads (queue_wait, drain) aggregate in /metrics but
-are not per-request attributable — by design, they are batch-scoped.
+collector/fetcher threads (queue_wait and its batch_form/dispatch_wait
+split, drain) aggregate in /metrics but are not per-request
+attributable — by design, they are batch-scoped.
 The one exception is the PLACEMENT LADDER: each queued executor item
 carries a reference to its request's trace, so the collector stamps the
 per-chip dispatch attempts (`placement_attempts`, engine/executor.py)

@@ -10,6 +10,17 @@ encode loop, SURVEY.md section 3.3 — the biggest architectural win).
 
 Transfers: images move host->device as uint8 (4x less PCIe/ICI traffic than
 f32); conversion to f32 happens on device and output returns as uint8.
+
+Buffer donation: the batch operand is compiled with `donate_argnums` so XLA
+may reuse the input's HBM for intermediates/outputs — on a memory-bound chip
+that halves the per-batch footprint and drops an allocation from the hot
+path. Donation is ALIASING-SAFE by construction here: launch_batch always
+stages the batch through a fresh copy (np.stack over the per-item arrays, or
+a device_put of that stack), so a frame-cache-resident host array is never
+the donated buffer — the donated array dies with the call and the cache's
+bytes are untouched (pinned by tests/test_continuous.py). Backends or
+programs that reject donation fall back to an undonated compile of the same
+chain, once, and latch donation off (donation_stats() exposes the event).
 """
 
 from __future__ import annotations
@@ -25,6 +36,56 @@ from imaginary_tpu.ops.plan import ImagePlan
 
 _CACHE: dict = {}
 _LOCK = threading.Lock()
+
+# Buffer-donation switch (process-wide, like the link seed): the executor
+# and prewarm must agree on it — the donate flag is part of the compile
+# cache key, so a prewarm/serve disagreement would recompile every chain
+# at first request. Flipped off by --donation off or latched off by the
+# first donation rejection.
+_DONATE = True
+_DONATION_REJECTED = 0
+
+# XLA tells us (per compile, as a Python warning) when a donated buffer
+# could not actually be aliased — e.g. the output bucket differs from the
+# input's so shapes don't line up. That is the expected, harmless case:
+# donation is permission, not obligation, and the input buffer still frees
+# at dispatch instead of at fetch. Silence it once, narrowly, or every
+# resize chain would warn on its first launch.
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message=".*[Dd]onated buffers? w[a-z]* not usable.*")
+
+
+def set_donation(enabled: bool) -> None:
+    """Operator/boot toggle (cli --donation); also resets the rejection
+    latch so a re-enable gets one fresh attempt."""
+    global _DONATE, _DONATION_REJECTED
+    with _LOCK:
+        _DONATE = bool(enabled)
+        _DONATION_REJECTED = 0
+
+
+def donation_enabled() -> bool:
+    return _DONATE
+
+
+def donation_stats() -> dict:
+    return {"enabled": _DONATE, "rejected": _DONATION_REJECTED}
+
+
+def _note_donation_rejected() -> None:
+    # latch OFF: a backend that rejected donation once will reject every
+    # call, and paying a failed dispatch + retry per batch forever would
+    # be strictly worse than serving undonated
+    global _DONATE, _DONATION_REJECTED
+    with _LOCK:
+        _DONATE = False
+        _DONATION_REJECTED += 1
+
+
+def _is_donation_error(e: BaseException) -> bool:
+    return "donat" in str(e).lower()
 
 
 def _run_chain(specs, x, h, w, dyns):
@@ -68,14 +129,18 @@ def _device_cache_key(device):
 
 
 def _compiled(specs: tuple, in_shape: tuple, dyn_shapes_key: tuple, shard_key=None,
-              device_key=None):
-    key = (specs, in_shape, dyn_shapes_key, shard_key, device_key)
+              device_key=None, donate: bool = False):
+    key = (specs, in_shape, dyn_shapes_key, shard_key, device_key, donate)
     fn = _CACHE.get(key)
     if fn is None:
         with _LOCK:
             fn = _CACHE.get(key)
             if fn is None:
-                fn = jax.jit(_run_chain, static_argnums=0)
+                # donate the batch operand only (argnum 1 of _run_chain):
+                # h/w/dyn vectors are bytes-trivial and donating them would
+                # invalidate arrays the caller may share across a group
+                fn = jax.jit(_run_chain, static_argnums=0,
+                             donate_argnums=(1,) if donate else ())
                 _CACHE[key] = fn
     return fn
 
@@ -104,7 +169,7 @@ def single_is_warm(arr: np.ndarray, plan: ImagePlan, sharding=None,
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
     return (specs, shape, dyn_key, _sharding_cache_key(sharding),
-            _device_cache_key(device)) in _CACHE
+            _device_cache_key(device), _DONATE) in _CACHE
 
 
 def clear_cache() -> None:
@@ -164,6 +229,10 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
         w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
     dyns = _stack_dyns(plans)
+    # The stacked host batch stays referenced so a donation-rejected retry
+    # can re-stage it: the donated device buffer may already be consumed by
+    # the failed attempt, but the host copy is untouchable by donation.
+    batch_host = batch
     if sharding is not None:
         # `sharding` may partition more than the batch axis (spatial
         # W-sharding for huge buckets). Per-item vectors and dyn params are
@@ -173,7 +242,6 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
 
         if isinstance(sharding, NamedSharding) and len(sharding.spec) > 1:
             vec_sharding = NamedSharding(sharding.mesh, PartitionSpec(sharding.spec[0]))
-        batch = jax.device_put(batch, sharding)
         h = jax.device_put(h, vec_sharding)
         w = jax.device_put(w, vec_sharding)
         dyns = tuple(
@@ -183,18 +251,46 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         # pin the whole call to one device: jit follows the operands'
         # placement, so a quarantine-routed batch never touches the sick
         # chip it was steered away from
-        batch = jax.device_put(batch, device)
         h = jax.device_put(h, device)
         w = jax.device_put(w, device)
         dyns = tuple(
             {k: jax.device_put(v, device) for k, v in d.items()} for d in dyns
         )
+
+    def _stage_batch():
+        # Explicit device_put on EVERY path (not just sharded/pinned): the
+        # H2D copy is issued asynchronously from the calling thread — the
+        # executor's collector — so staging chunk N+1 overlaps compute of
+        # chunk N and the fetcher's D2H of chunk N-1. The staged array is a
+        # fresh device buffer over the np.stack copy above, which is what
+        # makes donating it aliasing-safe.
+        if sharding is not None:
+            return jax.device_put(batch_host, sharding)
+        if device is not None:
+            return jax.device_put(batch_host, device)
+        return jax.device_put(batch_host)
+
+    donate = _DONATE
     dyn_key = tuple(
         tuple(sorted((k, v.shape, str(v.dtype)) for k, v in d.items())) for d in dyns
     )
-    fn = _compiled(specs, batch.shape, dyn_key, _sharding_cache_key(sharding),
-                   _device_cache_key(None if sharding is not None else device))
-    y, _, _ = fn(specs, jnp.asarray(batch), jnp.asarray(h), jnp.asarray(w), dyns)
+    shard_key = _sharding_cache_key(sharding)
+    dev_key = _device_cache_key(None if sharding is not None else device)
+    fn = _compiled(specs, batch.shape, dyn_key, shard_key, dev_key,
+                   donate=donate)
+    try:
+        y, _, _ = fn(specs, _stage_batch(), jnp.asarray(h), jnp.asarray(w), dyns)
+    except Exception as e:
+        if not (donate and _is_donation_error(e)):
+            raise
+        # Donation rejected (backend/program can't alias the operand):
+        # latch donation off and serve this call from an undonated compile
+        # of the same chain — re-staged from the host copy, since the
+        # failed attempt may have consumed the donated buffer.
+        _note_donation_rejected()
+        fn = _compiled(specs, batch.shape, dyn_key, shard_key, dev_key,
+                       donate=False)
+        y, _, _ = fn(specs, _stage_batch(), jnp.asarray(h), jnp.asarray(w), dyns)
     return y
 
 

@@ -78,61 +78,75 @@ def prewarm_common_chains(batch_sizes=None, verbose: bool = True) -> int:
             # derive from the executor's chunk cap so every padded batch
             # size a default deployment can form is compiled before bind
             batch_sizes = batch_ladder()
-    from imaginary_tpu.ops.plan import choose_decode_shrink
-
     built = 0
     seen = set()
     warmed: list = []  # (plan, kind, dh, dw, b) that compiled+ran clean
     t0 = time.time()
     for op, opts, (h, w) in _COMMON:
-        try:
-            shrink = choose_decode_shrink(op, opts, h, w, 0, 3)
-        except Exception:
-            shrink = 1
-        # warm the full bucket (PNG/WebP traffic decodes full-size) AND the
-        # shrink-on-load bucket JPEG traffic actually serves
-        dims = {(h, w), ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink)}
-        try:
-            from imaginary_tpu import codecs as _codecs
-
-            warm_yuv = _codecs.yuv420_supported()
-        except Exception:
-            warm_yuv = False
-        for dh, dw in dims:
-            try:
-                plan = plan_operation(op, opts, dh, dw, 0, 3)
-            except Exception:
-                continue
-            plans = [(plan, None)]
-            if warm_yuv and plan.stages:
-                # JPEG traffic serves over the packed-YUV420 transport: warm
-                # that chain too, with a pre-padded packed dummy input
-                from imaginary_tpu.ops.plan import wrap_plan_yuv420
-
-                plans.append((wrap_plan_yuv420(plan, dh, dw), "yuv"))
-            for pl, kind in plans:
-                for b in batch_sizes:
-                    key = (pl.spec_key(), chain_mod.bucket_shape(dh, dw), b)
-                    if key in seen:
-                        continue
-                    seen.add(key)
-                    try:
-                        if kind == "yuv":
-                            ph, wb = pl.in_bucket
-                            arr = np.zeros((ph, wb, 1), dtype=np.uint8)
-                        else:
-                            arr = np.zeros((dh, dw, 3), dtype=np.uint8)
-                        chain_mod.run_batch([arr] * b, [pl] * b)
-                        built += 1
-                        warmed.append((pl, kind, dh, dw, b))
-                    except Exception:
-                        continue
+        built += warm_chain(op, opts, h, w, batch_sizes,
+                            seen=seen, warmed=warmed)
     seeded = _seed_link_rate(warmed)
     if verbose:
         msg = f"prewarmed {built} op-chain programs in {time.time() - t0:.1f}s"
         if seeded:
             msg += f"; link seeded at {seeded[0]:.2f} ms/MB (floor {seeded[1]:.1f} ms)"
         print(msg)
+    return built
+
+
+def warm_chain(op: str, opts: ImageOptions, h: int, w: int,
+               batch_sizes, seen=None, warmed=None) -> int:
+    """Compile-and-run every device program one (operation, options,
+    source dims) combination can hit: the full bucket (PNG/WebP traffic
+    decodes full-size) AND the shrink-on-load bucket JPEG traffic actually
+    serves, the RGB and (when the native codec is present) packed-YUV420
+    transports, at every requested batch-ladder rung. Returns the number
+    of programs built. Shared by boot prewarm (prewarm_common_chains) and
+    by bench_device.py's policy A/B row, which warms exactly its own
+    chain through this function and then asserts the executor's
+    compile_misses counter stays 0 for the whole run."""
+    from imaginary_tpu.ops.plan import choose_decode_shrink
+
+    if seen is None:
+        seen = set()
+    built = 0
+    try:
+        shrink = choose_decode_shrink(op, opts, h, w, 0, 3)
+    except Exception:
+        shrink = 1
+    dims = {(h, w), ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink)}
+    try:
+        from imaginary_tpu import codecs as _codecs
+
+        warm_yuv = _codecs.yuv420_supported()
+    except Exception:
+        warm_yuv = False
+    for dh, dw in dims:
+        try:
+            plan = plan_operation(op, opts, dh, dw, 0, 3)
+        except Exception:
+            continue
+        plans = [(plan, None)]
+        if warm_yuv and plan.stages:
+            # JPEG traffic serves over the packed-YUV420 transport: warm
+            # that chain too, with a pre-padded packed dummy input
+            from imaginary_tpu.ops.plan import wrap_plan_yuv420
+
+            plans.append((wrap_plan_yuv420(plan, dh, dw), "yuv"))
+        for pl, kind in plans:
+            for b in batch_sizes:
+                key = (pl.spec_key(), chain_mod.bucket_shape(dh, dw), b)
+                if key in seen:
+                    continue
+                seen.add(key)
+                try:
+                    arr = _dummy_input(pl, kind, dh, dw)
+                    chain_mod.run_batch([arr] * b, [pl] * b)
+                    built += 1
+                    if warmed is not None:
+                        warmed.append((pl, kind, dh, dw, b))
+                except Exception:
+                    continue
     return built
 
 

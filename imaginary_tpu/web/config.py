@@ -100,6 +100,18 @@ class ServerOptions:
     # default mirrors engine.executor.MAX_BATCH (kept literal here so this
     # config module stays import-light; test_engine pins the two equal)
     max_batch: int = 16
+    # Continuous-batching collector (engine/executor.py module docstring):
+    # "continuous" (default) admits arrivals into the next in-flight chunk
+    # with formation delay capped at batch_form_ms; "convoy" is the legacy
+    # accumulate-launch-drain policy kept for A/B measurement.
+    batch_policy: str = "continuous"
+    batch_form_ms: float = 5.0
+    # launched-but-unfetched device groups (the double-buffer depth: H2D of
+    # N+1 overlaps compute of N and D2H of N-1; mirrors ExecutorConfig)
+    max_inflight: int = 4
+    # donate the batch operand to XLA so input HBM is reused for outputs
+    # (ops/chain.py); rejection latches it off with a counted fallback
+    donation: bool = True
     use_mesh: bool = False
     n_devices: Optional[int] = None
     spatial: int = 1  # spatial mesh axis (W-sharding for >=4K inputs)

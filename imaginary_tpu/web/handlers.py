@@ -112,10 +112,19 @@ class ImageService:
             # disables the source cache), not by per-request polling
             pressure.on_transition(
                 lambda _old, new: self.caches.apply_pressure(new))
+        # donation rides the chain module (the donate flag is part of the
+        # compile-cache key, shared with prewarm): set before the executor
+        # exists so its first dispatch compiles what serving will use
+        from imaginary_tpu.ops import chain as chain_mod
+
+        chain_mod.set_donation(o.donation)
         self.executor = Executor(
             ExecutorConfig(
                 window_ms=o.batch_window_ms,
                 max_batch=o.max_batch,
+                batch_policy=o.batch_policy,
+                max_form_ms=o.batch_form_ms,
+                max_inflight=max(1, o.max_inflight),
                 use_mesh=o.use_mesh,
                 n_devices=o.n_devices,
                 spatial=o.spatial,

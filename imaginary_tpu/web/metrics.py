@@ -31,6 +31,9 @@ _EXEC_GAUGES = {
     "compile_cache_size", "device_ms_per_mb", "host_ms_per_mpix",
     "host_inflight", "host_owed_mpix", "host_spill_p50_ms",
     "host_spill_p99_ms", "device_owed_mb",
+    "batch_form_p50_ms", "batch_form_p99_ms",
+    "dispatch_wait_p50_ms", "dispatch_wait_p99_ms",
+    "donation_enabled",
 }
 _CACHE_GAUGES = {
     "result_items", "result_bytes", "frame_items", "frame_bytes",

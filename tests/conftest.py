@@ -36,6 +36,13 @@ def pytest_configure(config):
     # warning-free output both hold
     config.addinivalue_line(
         "markers", "slow: long-running test excluded from the tier-1 gate")
+    # pytest resets the warnings machinery per test, which would undo the
+    # narrow module-level filter ops/chain.py installs for XLA's expected
+    # could-not-alias donation notice (output bucket != input bucket);
+    # mirror it here so suite output stays readable
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable")
 
 
 @pytest.fixture(autouse=True)

@@ -282,23 +282,29 @@ class TestSpeedParam:
     bimg AVIF/HEIF effort); r4 parsed it and dropped it. The knob must
     observably change the encode."""
 
-    def test_heif_speed_changes_encode_time(self):
+    def test_heif_speed_changes_encode(self):
+        """The knob's effect is asserted on the encoded BYTES, not on
+        wall-clock: the old speed-0-vs-9 timing assertion was load-flaky
+        under `make gate` (a preempted side inverted the ratio) and on
+        this host's libaom the true idle-host gap is ~1.15x — below any
+        noise-proof floor; the original only passed because the first
+        encode absorbed the plugin's init cost. aom's speed setting
+        changes its RD search, so on structured content the two streams
+        differ deterministically, host load be damned."""
         from imaginary_tpu.codecs import vector_backend as vb
 
         if not vb.heif_encode_available("av1"):
             pytest.skip("no AV1 encoder plugin on host")
-        import time
-
-        rng = np.random.default_rng(1)
-        arr = rng.integers(0, 256, (256, 256, 3), np.uint8).astype(np.uint8)
-        t0 = time.perf_counter()
-        vb.encode_heif(arr, 60, "av1", speed=0)
-        t_default = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        vb.encode_heif(arr, 60, "av1", speed=9)
-        t_fast = time.perf_counter() - t0
-        # measured 5.8x on this host; 1.5x is the noise-proof floor
-        assert t_fast < t_default / 1.5
+        # smooth gradient (noise images can collapse to identical streams
+        # at every speed — measured on this host's aom)
+        row = np.linspace(0, 255, 256).astype(np.uint8)
+        arr = np.dstack([np.tile(row, (256, 1))] * 3)
+        slow = vb.encode_heif(arr, 60, "av1", speed=2)
+        fast = vb.encode_heif(arr, 60, "av1", speed=9)
+        # same-speed re-encode pins determinism: the slow-vs-fast byte
+        # difference below is the KNOB, not encoder nondeterminism
+        assert vb.encode_heif(arr, 60, "av1", speed=2) == slow
+        assert slow != fast
 
     def test_speed_flows_from_query_to_avif_encode(self):
         """?speed= reaches the AVIF encoder through the live pipeline."""
