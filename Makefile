@@ -27,19 +27,25 @@ gate: lint test chaos
 	  { echo "bench_device.py policy A/B failed - snapshot NOT green"; exit 1; }
 	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device benches all pass"
 
-# Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7): the deadline/failpoint/
-# devhealth/pressure suites, then four soaks — a flaky-origin row
-# (source.fetch=error(0.2): availability >= 95%, honest 502/503/504
-# mapping, deadline boundedness, ledgers at rest), a chip-loss row
-# (device.chip_error on the primary device mid-run: failover keeps
-# serving, the sick chip quarantines alone, the probe re-admits it after
-# its cooldown), a hedge A-B row, and an OOM-storm row (device.oom at
-# p=0.5: every request completes via bisect-retry or host routing, the
-# breaker never opens, ledgers at rest). The two forced CPU devices make
-# the multi-chip fault-domain path run on hardware-less CI; real
-# multi-chip hosts exercise it natively.
+# Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10): the deadline/
+# failpoint/devhealth/pressure/integrity suites, then six soaks — a
+# flaky-origin row (source.fetch=error(0.2): availability >= 95%, honest
+# 502/503/504 mapping, deadline boundedness, ledgers at rest), a
+# chip-loss row (device.chip_error on the primary device mid-run:
+# failover keeps serving, the sick chip quarantines alone, the probe
+# re-admits it after its cooldown), a hedge A-B row, an OOM-storm row
+# (device.oom at p=0.5: every request completes via bisect-retry or host
+# routing, the breaker never opens, ledgers at rest), an SDC-storm row
+# (device.corrupt[0] under --integrity sample 1.0: zero corrupted bytes
+# served, every mismatch re-served from the verified copy, the lying
+# chip quarantined alone, availability >= 99%), and a fail-slow row
+# (device.slow[0]=delay(250ms): the limping chip demotes on the golden-
+# probe latency comparison and fleet p99 recovers to within 1.5x of the
+# healthy baseline). The two forced CPU devices make the multi-chip
+# fault-domain path run on hardware-less CI; real multi-chip hosts
+# exercise it natively.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py -q -m 'not slow'
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py -q -m 'not slow'
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
 	  JAX_PLATFORMS=cpu python bench_chaos.py || \

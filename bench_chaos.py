@@ -36,8 +36,29 @@ device path. Invariants: every request completes (>= 95% 2xx, zero raw
 splits AND host routings, the breaker NEVER opens (OOM is capacity, not
 fault), and the owed-work ledgers are at rest afterward.
 
+ROW 5 — SDC storm (ISSUE 10): `device.corrupt[0]=error` makes chip 0
+silently flip bytes in every drained output, with `--integrity` on at
+sample 1.0 so EVERY device chunk is cross-verified before release.
+Invariants: zero corrupted bytes reach clients (every mismatch is
+transparently re-served from the verified host copy: reserved ==
+mismatches), the lying chip takes corruption strikes and quarantines
+ALONE while its peer serves, availability >= 99%, and after the fault
+clears the golden probe re-admits it only after the configured clean
+streak. 1-device hosts degrade to corruption-strike -> breaker -> host
+failover and still hold availability.
+
+ROW 6 — fail-slow (ISSUE 10): `device.slow[0]=delay(250ms)` makes chip
+0 limp without ever erroring — the failure mode no breaker can see.
+With `--failslow-ratio` armed, the golden-probe latency comparison
+demotes the chip, production sheds to its healthy peer, and fleet p99
+recovers to within 1.5x of the healthy baseline with no availability
+loss. 1-device hosts assert the documented no-op degeneration (no
+peers, no demotion, availability holds).
+
 Prints one JSON line per row on stdout; human detail on stderr; nonzero
-exit on any violated invariant.
+exit on any violated invariant. Integrity/fail-slow counters from rows
+5-6 are archived to artifacts/chaos_integrity.json next to the BENCH
+artifacts.
 """
 
 from __future__ import annotations
@@ -476,6 +497,303 @@ def _oom_storm_row(duration: float, concurrency: int) -> int:
     return 0
 
 
+async def _sdc_storm_soak(duration: float, concurrency: int) -> dict:
+    """Three phases against one --integrity server: warm (clean
+    verification prices in), fault (device.corrupt armed on the primary:
+    every chunk it serves is byte-flipped, every mismatch must be caught
+    and re-served), recovery (fault cleared; the golden probe must pay
+    down the clean streak and re-admit)."""
+    from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    # sample 1.0: the "zero corrupted bytes served" invariant only holds
+    # when EVERY device chunk is verified; host_spill off pins traffic to
+    # the device path so the corruption is actually exercised
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, request_timeout_s=10.0, host_spill=False,
+        integrity=True, integrity_sample=1.0, integrity_clean_probes=2))
+    ex = app["service"].executor
+    integ = ex.integrity
+    counts: dict = {}
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def drive(seconds: float) -> None:
+                deadline = time.monotonic() + seconds
+
+                async def worker():
+                    while time.monotonic() < deadline:
+                        try:
+                            async with session.get(next(urls)) as res:
+                                await res.read()
+                                counts[res.status] = counts.get(res.status, 0) + 1
+                        except Exception:
+                            counts["exc"] = counts.get("exc", 0) + 1
+
+                await asyncio.gather(*[worker() for _ in range(concurrency)])
+
+            await drive(max(duration / 4, 1.0))  # warm: clean checks book
+            clean_mismatches = integ.mismatches
+            multi = len(ex.devhealth) > 1
+            ex.devhealth.cooldown_s = 1.5  # recovery inside the run
+            spec = ("device.corrupt[0]=error" if multi
+                    else "device.corrupt=error")
+            print(f"[chaos] SDC storm: arming {spec!r} "
+                  f"({len(ex.devhealth)} device(s))", file=sys.stderr)
+            failpoints.activate(spec)
+            # sample DURING the fault (same race as the chip-loss row:
+            # the invariant is "at some point the lying chip was
+            # quarantined ALONE while a healthy peer served")
+            mid = {"quarantined": 0, "healthy": 0}
+            fault_s = max(duration / 2, 2.0)
+
+            async def sample(deadline: float) -> None:
+                while time.monotonic() < deadline:
+                    s = ex.devhealth.snapshot()
+                    if s["quarantined"] == 1:
+                        mid["quarantined"] = 1
+                        mid["healthy"] = max(mid["healthy"], s["healthy"])
+                    await asyncio.sleep(0.05)
+
+            await asyncio.gather(drive(fault_s),
+                                 sample(time.monotonic() + fault_s))
+            failpoints.deactivate()
+            await drive(max(duration / 4, 1.0))
+            end_t = time.monotonic() + 15.0
+            readmitted = False
+            while time.monotonic() < end_t:
+                snap = ex.devhealth.snapshot()
+                if snap["quarantined"] == 0 and snap["degraded"] == 0:
+                    readmitted = True
+                    break
+                await asyncio.sleep(0.1)
+                await drive(0.2)  # single-device half-open needs traffic
+        final = ex.devhealth.snapshot()
+    finally:
+        failpoints.deactivate()
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"counts": counts, "multi_device": multi,
+            "quarantined_mid_fault": mid["quarantined"],
+            "healthy_mid_fault": mid["healthy"],
+            "readmitted": readmitted,
+            "clean_mismatches": clean_mismatches,
+            "final_devices": final,
+            "integrity": integ.snapshot(),
+            "corruptions": final["corruptions"]}
+
+
+def _sdc_storm_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_sdc_storm_soak(duration, concurrency))
+    counts = got["counts"]
+    total = sum(counts.values())
+    ok = counts.get(200, 0)
+    integ = got["integrity"]
+    row = {
+        "metric": "chaos_sdc_storm",
+        "requests": total,
+        "ok": ok,
+        "ok_ratio": round(ok / total, 4) if total else 0.0,
+        "multi_device": got["multi_device"],
+        "quarantined_mid_fault": got["quarantined_mid_fault"],
+        "healthy_mid_fault": got["healthy_mid_fault"],
+        "readmitted": got["readmitted"],
+        "corruption_strikes": got["corruptions"],
+        "integrity": integ,
+        "counts": {str(k): v for k, v in sorted(counts.items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if total == 0:
+        fails.append("SDC storm produced zero requests")
+    if total and ok / total < 0.99:
+        fails.append(f"availability {ok}/{total} below 99% under SDC storm")
+    if got["clean_mismatches"]:
+        fails.append(f"{got['clean_mismatches']} false-positive mismatches "
+                     "on CLEAN warm traffic (tolerance too tight)")
+    if integ["mismatches"] == 0:
+        fails.append("corrupt chip never caught by sampled verification")
+    if integ["reserved"] != integ["mismatches"]:
+        fails.append(
+            f"{integ['mismatches'] - integ['reserved']} caught mismatches "
+            "NOT re-served from the verified copy (corrupted bytes leaked)")
+    if got["corruptions"] == 0:
+        fails.append("no corruption strike ever booked")
+    if got["multi_device"]:
+        if got["quarantined_mid_fault"] != 1:
+            fails.append("lying chip did not quarantine alone "
+                         f"(quarantined={got['quarantined_mid_fault']})")
+        if got["healthy_mid_fault"] < 1:
+            fails.append("no healthy device kept serving during the storm")
+    if not got["readmitted"]:
+        fails.append("chip not re-admitted after the clean-probe streak")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    mode = ("quarantined alone, peer served" if got["multi_device"]
+            else "breaker->host failover")
+    print(f"[chaos] PASS (SDC storm, {mode}): {ok}/{total} ok, "
+          f"{integ['mismatches']} mismatches all re-served verified, "
+          f"{got['corruptions']} corruption strikes, re-admitted after "
+          "clean streak", file=sys.stderr)
+    return 0, row
+
+
+async def _failslow_soak(duration: float, concurrency: int) -> dict:
+    """Baseline -> limp -> demote -> recovered-p99 phases against one
+    --failslow server. The limp is device.slow[0]=delay(250ms): chip 0
+    never errors, it just drags every chunk (and its golden probes) —
+    the failure no breaker can see."""
+    from bench_cache import N_URLS, ZIPF_S, _start_origin, _start_server, _zipf_indices
+    from bench_util import make_1080p_jpeg
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.web.config import ServerOptions
+
+    base_jpeg = make_1080p_jpeg()
+    variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+    origin_runner, origin_base = await _start_origin(variants)
+    server_runner, app, base = await _start_server(ServerOptions(
+        enable_url_source=True, request_timeout_s=10.0, host_spill=False,
+        failslow_ratio=2.5, failslow_min_samples=3))
+    ex = app["service"].executor
+    counts: dict = {}
+    base_lats: list = []
+    after_lats: list = []
+    try:
+        seq = _zipf_indices(200_000, N_URLS, ZIPF_S)
+        urls = itertools.cycle([
+            f"{base}/resize?width=300&height=200&url={origin_base}/img/{i}"
+            for i in seq
+        ])
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+
+            async def drive(seconds: float, lats=None) -> None:
+                deadline = time.monotonic() + seconds
+
+                async def worker():
+                    while time.monotonic() < deadline:
+                        t0 = time.monotonic()
+                        try:
+                            async with session.get(next(urls)) as res:
+                                await res.read()
+                                counts[res.status] = counts.get(res.status, 0) + 1
+                        except Exception:
+                            counts["exc"] = counts.get("exc", 0) + 1
+                        if lats is not None:
+                            lats.append((time.monotonic() - t0) * 1000.0)
+
+                await asyncio.gather(*[worker() for _ in range(concurrency)])
+
+            # phase 1: healthy baseline (devices resolved, probes running)
+            await drive(max(duration / 3, 2.0), base_lats)
+            multi = len(ex.devhealth) > 1
+            print(f"[chaos] fail-slow: arming device.slow[0]=delay(250ms) "
+                  f"({len(ex.devhealth)} device(s))", file=sys.stderr)
+            failpoints.activate("device.slow[0]=delay(250ms)"
+                                if multi else "device.slow=delay(250ms)")
+            # phase 2: drive until the probe comparison demotes chip 0
+            demoted = False
+            end_t = time.monotonic() + max(duration * 2, 25.0)
+            while time.monotonic() < end_t and multi:
+                await drive(0.5)
+                r0 = ex.devhealth.record(0)
+                if r0.degraded or ex.devhealth.is_quarantined(0):
+                    demoted = True
+                    break
+            if not multi:
+                await drive(max(duration / 3, 2.0))
+            # phase 3: recovered p99, measured only after demotion
+            await drive(max(duration / 3, 2.0), after_lats)
+            failpoints.deactivate()
+            snap = ex.devhealth.snapshot()
+    finally:
+        failpoints.deactivate()
+        await server_runner.cleanup()
+        await origin_runner.cleanup()
+    return {"counts": counts, "multi_device": multi, "demoted": demoted,
+            "base_lats": base_lats, "after_lats": after_lats,
+            "devices": snap}
+
+
+def _failslow_row(duration: float, concurrency: int) -> tuple:
+    from bench_util import pctl
+
+    got = asyncio.run(_failslow_soak(duration, concurrency))
+    counts = got["counts"]
+    total = sum(counts.values())
+    ok = counts.get(200, 0)
+    p99_base = pctl(got["base_lats"], 0.99)
+    p99_after = pctl(got["after_lats"], 0.99)
+    per = {d["device"]: d for d in got["devices"]["per_device"]}
+    row = {
+        "metric": "chaos_failslow",
+        "unit": "ms",
+        "requests": total,
+        "ok": ok,
+        "ok_ratio": round(ok / total, 4) if total else 0.0,
+        "multi_device": got["multi_device"],
+        "demoted": got["demoted"],
+        "p99_ms_healthy_baseline": p99_base,
+        "p99_ms_after_demotion": p99_after,
+        "p50_ms_healthy_baseline": pctl(got["base_lats"], 0.50),
+        "p50_ms_after_demotion": pctl(got["after_lats"], 0.50),
+        "demotions": sum(d["demotions"] for d in per.values()),
+        "probe_latency_ewma_ms": {
+            str(k): d["probe_latency_ewma_ms"] for k, d in per.items()},
+        "counts": {str(k): v for k, v in sorted(counts.items(), key=str)},
+    }
+    print(json.dumps(row))
+
+    fails = []
+    if total == 0:
+        fails.append("fail-slow soak produced zero requests")
+    if total and ok / total < 0.99:
+        fails.append(f"availability {ok}/{total} below 99% (fail-slow must "
+                     "cost latency, never availability)")
+    if got["multi_device"]:
+        if not got["demoted"]:
+            fails.append("limping chip was never demoted")
+        # the ISSUE bound, with a small absolute floor so a sub-50ms
+        # baseline on an idle host doesn't turn scheduler noise into a
+        # false failure
+        bound = max(1.5 * p99_base, p99_base + 50.0)
+        if p99_after > bound:
+            fails.append(f"fleet p99 after demotion {p99_after:.0f}ms "
+                         f"exceeds bound {bound:.0f}ms "
+                         f"(healthy baseline {p99_base:.0f}ms)")
+    else:
+        # single-device degeneration: no peers, no demotion, ever
+        if any(d["demotions"] for d in per.values()):
+            fails.append("single-device fleet demoted itself "
+                         "(no-op degeneration violated)")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    if got["multi_device"]:
+        print(f"[chaos] PASS (fail-slow): demoted, p99 "
+              f"{p99_base:.0f}ms baseline -> {p99_after:.0f}ms after "
+              f"demotion (bound 1.5x), {ok}/{total} ok", file=sys.stderr)
+    else:
+        print(f"[chaos] PASS (fail-slow, 1 device): no-op degeneration "
+              f"held, {ok}/{total} ok", file=sys.stderr)
+    return 0, row
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -543,7 +861,24 @@ def main() -> int:
     if rc:
         return rc
     # ROW 4: OOM storm — bisect-retry + host routing keep availability
-    return _oom_storm_row(max(duration / 2, 2.0), concurrency)
+    rc = _oom_storm_row(max(duration / 2, 2.0), concurrency)
+    if rc:
+        return rc
+    # ROW 5 + 6 (ISSUE 10): SDC storm + fail-slow; their integrity/
+    # devhealth counters are archived next to the BENCH artifacts
+    rc_sdc, sdc_row = _sdc_storm_row(duration, concurrency)
+    rc_fs, fs_row = _failslow_row(duration, concurrency)
+    try:
+        os.makedirs("artifacts", exist_ok=True)
+        with open("artifacts/chaos_integrity.json", "w") as f:
+            json.dump({"sdc_storm": sdc_row, "failslow": fs_row}, f,
+                      indent=2, sort_keys=True)
+        print("[chaos] integrity counters archived to "
+              "artifacts/chaos_integrity.json", file=sys.stderr)
+    except OSError as e:
+        print(f"[chaos] WARN: could not archive integrity counters: {e}",
+              file=sys.stderr)
+    return rc_sdc or rc_fs
 
 
 if __name__ == "__main__":

@@ -259,6 +259,52 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fraction of --max-allowed-resolution the critical "
                         "rung's pixel-admission clamp allows (source and "
                         "requested output dims)")
+    # output-integrity defense (imaginary_tpu/engine/integrity.py) + fail-slow
+    # demotion (engine/devhealth.py); defaults OFF (--integrity absent and
+    # --failslow-ratio 0 build no state — byte parity with the pre-defense
+    # serving path)
+    p.add_argument("--integrity", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_INTEGRITY"),
+                   help="arm the output-integrity defense: golden-probe "
+                        "canaries on device re-admission, sampled "
+                        "cross-verification of device batches (mismatch = "
+                        "corruption strike + transparent re-serve from the "
+                        "verified copy), and poison-batch isolation")
+    p.add_argument("--integrity-sample", type=float,
+                   default=_env_float("IMAGINARY_TPU_INTEGRITY_SAMPLE",
+                                      1.0 / 256.0),
+                   help="fraction of production device batches recomputed "
+                        "on the host (or a peer chip) and compared before "
+                        "the response is released (default 1/256; 1.0 "
+                        "verifies every batch)")
+    p.add_argument("--integrity-clean-probes", type=int,
+                   default=_env_int("IMAGINARY_TPU_INTEGRITY_CLEAN_PROBES", 3),
+                   help="consecutive clean golden probes a corruption-"
+                        "struck device must pass before re-admission")
+    p.add_argument("--integrity-poison-ttl", type=float,
+                   default=_env_float("IMAGINARY_TPU_INTEGRITY_POISON_TTL",
+                                      300.0),
+                   help="seconds a convicted poison input stays in the "
+                        "digest quarantine list (routed host/422 instead "
+                        "of re-poisoning device batches)")
+    p.add_argument("--integrity-poison-cap", type=int,
+                   default=_env_int("IMAGINARY_TPU_INTEGRITY_POISON_CAP", 256),
+                   help="max poison quarantine entries (oldest evicted)")
+    p.add_argument("--failslow-ratio", type=float,
+                   default=_env_float("IMAGINARY_TPU_FAILSLOW_RATIO", 0.0),
+                   help="demote a device to `degraded` when its per-chunk "
+                        "latency EWMA exceeds this ratio x the median of "
+                        "its peers' EWMAs (sheds its dispatch share to "
+                        "healthy chips; quarantines if it keeps slipping; "
+                        "golden probe re-admits); 0 disables")
+    p.add_argument("--failslow-min-samples", type=int,
+                   default=_env_int("IMAGINARY_TPU_FAILSLOW_MIN_SAMPLES", 8),
+                   help="latency samples a device and its peers each need "
+                        "before fail-slow demotion may trigger")
+    p.add_argument("--failslow-share", type=float,
+                   default=_env_float("IMAGINARY_TPU_FAILSLOW_SHARE", 0.0),
+                   help="fraction of its dispatch rotation a degraded "
+                        "device keeps (0 = full shed)")
     # multi-tenant QoS (imaginary_tpu/qos/): tenant table + priority
     # classes + per-tenant rates/shares; defaults OFF (single default
     # tenant, FIFO executor intake, byte-identical responses)
@@ -487,6 +533,14 @@ def options_from_args(args) -> ServerOptions:
         source_connect_timeout_s=max(0.001, args.source_connect_timeout),
         source_read_timeout_s=max(0.001, args.source_read_timeout),
         qos_config=args.qos_config,
+        integrity=args.integrity,
+        integrity_sample=min(1.0, max(0.0, args.integrity_sample)),
+        integrity_clean_probes=max(1, args.integrity_clean_probes),
+        integrity_poison_ttl=max(0.0, args.integrity_poison_ttl),
+        integrity_poison_cap=max(1, args.integrity_poison_cap),
+        failslow_ratio=max(0.0, args.failslow_ratio),
+        failslow_min_samples=max(1, args.failslow_min_samples),
+        failslow_share=min(1.0, max(0.0, args.failslow_share)),
         pressure_rss_mb=max(0.0, args.pressure_rss_mb),
         pressure_hbm_mb=max(0.0, args.pressure_hbm_mb),
         pressure_elevated_frac=min(1.0, max(0.01, args.pressure_elevated_frac)),

@@ -32,11 +32,28 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 STATE_HEALTHY = "healthy"
 STATE_QUARANTINED = "quarantined"
 STATE_HALF_OPEN = "half_open"
+# Fail-slow demotion (Gunawi et al., "Fail-Slow at Scale", FAST'18): the
+# device is dispatchable but limping — its latency EWMA exceeds the
+# fail-slow ratio x the median of its PEERS' EWMAs — so it sheds its
+# traffic share to healthy chips (registry.pick weights it down to
+# failslow_share, default 0) until its probe latencies recover, and
+# quarantines outright if it keeps slipping.
+STATE_DEGRADED = "degraded"
+
+
+class CorruptionError(RuntimeError):
+    """A device produced WRONG BYTES (golden-probe mismatch or sampled
+    cross-verification failure) — silent data corruption, not a crash.
+    The probe loop books these as corruption strikes (note_corruption):
+    an instant quarantine that stays poisoned until N consecutive clean
+    probes, because a chip that lies once cannot be trusted on its next
+    single success."""
 
 
 class DeviceRecord:
@@ -47,7 +64,9 @@ class DeviceRecord:
         "idx", "consecutive_failures", "failures", "successes",
         "breaker_opens", "quarantined_until", "error_ewma",
         "latency_ewma_ms", "last_probe_t", "probes", "readmissions",
-        "last_error", "oom_events",
+        "last_error", "oom_events", "corruptions", "clean_probes_needed",
+        "latency_samples", "probe_latency_ewma_ms", "probe_latency_samples",
+        "degraded", "slow_strikes", "demotions", "failslow_quarantines",
     )
 
     def __init__(self, idx: int):
@@ -62,12 +81,45 @@ class DeviceRecord:
         # chip for being asked to hold too much would convert a sizing
         # problem into an availability outage)
         self.oom_events = 0
+        # CORRUPTION strikes (golden-probe mismatch / failed sampled
+        # cross-verification): the device returned wrong bytes. Counted
+        # separately from crash failures — a chip that lies is worse than
+        # a chip that dies, and quarantines instantly.
+        self.corruptions = 0
+        # Clean golden probes still required before re-admission: a
+        # corruption strike sets this to the configured count, and only
+        # note_probe_ok decrements it — a single lucky probe must not
+        # re-admit a mercurial core.
+        self.clean_probes_needed = 0
         self.quarantined_until = 0.0  # monotonic; 0 = never tripped
         # Slow-moving rates for operators (the breaker itself acts on the
         # consecutive count — an EWMA would both trip late on a hard-down
         # chip and flap on a merely-noisy one).
         self.error_ewma = 0.0
-        self.latency_ewma_ms = 0.0
+        # None = never sampled. A 0.0 sentinel would make a genuine 0.0 ms
+        # first sample re-seed the EWMA forever (the ISSUE 10 bug).
+        self.latency_ewma_ms: Optional[float] = None
+        self.latency_samples = 0
+        # GOLDEN-PROBE latency EWMA, the fail-slow comparison's signal.
+        # Production latency (latency_ewma_ms above) is structurally
+        # incomparable across devices under sticky-primary dispatch: the
+        # primary's samples are loaded production drains, its idle peers
+        # have none — so a fleet-median test over it either never fires
+        # (no peer data) or demotes the healthy primary for the crime of
+        # serving. The periodic golden probe runs the SAME chain on EVERY
+        # device at the same cadence; its latencies are the one
+        # apples-to-apples cross-device signal. (Trade-off, documented:
+        # a chip that limps only under production load and probes clean
+        # escapes demotion — the crash breaker still owns it if it
+        # degrades further.)
+        self.probe_latency_ewma_ms: Optional[float] = None
+        self.probe_latency_samples = 0
+        # fail-slow demotion state (STATE_DEGRADED): set/cleared only by
+        # _eval_failslow, which only runs when a ratio is configured
+        self.degraded = False
+        self.slow_strikes = 0
+        self.demotions = 0
+        self.failslow_quarantines = 0
         self.last_probe_t = 0.0
         self.probes = 0
         self.readmissions = 0
@@ -80,6 +132,8 @@ class DeviceRecord:
             # cooldown expired but no success has closed the breaker yet:
             # the next attempt (request on 1 device, probe on many) decides
             return STATE_HALF_OPEN
+        if self.degraded:
+            return STATE_DEGRADED
         return STATE_HEALTHY
 
     def to_dict(self, now: float) -> dict:
@@ -91,9 +145,16 @@ class DeviceRecord:
             "successes": self.successes,
             "breaker_opens": self.breaker_opens,
             "oom_events": self.oom_events,
+            "corruptions": self.corruptions,
+            "clean_probes_needed": self.clean_probes_needed,
             "quarantined_for_s": round(max(0.0, self.quarantined_until - now), 3),
             "error_ewma": round(self.error_ewma, 4),
-            "latency_ewma_ms": round(self.latency_ewma_ms, 3),
+            "latency_ewma_ms": round(self.latency_ewma_ms or 0.0, 3),
+            "latency_samples": self.latency_samples,
+            "probe_latency_ewma_ms": round(self.probe_latency_ewma_ms or 0.0, 3),
+            "probe_latency_samples": self.probe_latency_samples,
+            "demotions": self.demotions,
+            "failslow_quarantines": self.failslow_quarantines,
             "probes": self.probes,
             "readmissions": self.readmissions,
             "last_error": self.last_error,
@@ -120,8 +181,38 @@ class DeviceHealthRegistry:
         # the topology change" check for consumers that cache a derived
         # view (the executor's healthy-mesh sharding)
         self.generation = 0
+        # Integrity/fail-slow knobs, all inert at their defaults (the
+        # executor configures them from its own config; the parity path
+        # never calls configure_failslow and never books corruption).
+        self.corruption_clean_probes = 3
+        self._fs_ratio = 0.0  # 0 = fail-slow demotion off
+        self._fs_min_samples = 8
+        self._fs_share = 0.0  # degraded device's retained traffic share
+        self._fs_strikes = 8  # still-slow evaluations while degraded -> quarantine
+        self._pick_tick = 0  # degraded-share round-robin counter
+        # /debugz strike history: one entry per quarantine-grade event
+        # (crash trip, corruption strike, fail-slow demote/quarantine,
+        # watchdog), newest last. Epoch timestamps — operators correlate
+        # these with logs, not with the monotonic clock.
+        self._strikes: deque = deque(maxlen=64)
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_stop = threading.Event()
+
+    def configure_failslow(self, ratio: float, min_samples: int = 8,
+                           share: float = 0.0, strikes: int = 8) -> None:
+        """Arm fail-slow demotion: a device whose latency EWMA exceeds
+        `ratio` x the median of its PEERS' EWMAs (peers needing >=
+        `min_samples` samples each — the hysteresis that keeps a cold
+        fleet from demoting its first chip) is DEGRADED: pick() sheds its
+        traffic down to `share` of its normal rotation (0 = full shed),
+        and `strikes` further still-slow samples while degraded
+        quarantine it outright. With one device there are no peers and
+        the evaluation is a no-op by construction."""
+        with self._lock:
+            self._fs_ratio = max(0.0, float(ratio))
+            self._fs_min_samples = max(1, int(min_samples))
+            self._fs_share = max(0.0, min(1.0, float(share)))
+            self._fs_strikes = max(1, int(strikes))
 
     # -- shape -----------------------------------------------------------
 
@@ -145,6 +236,20 @@ class DeviceHealthRegistry:
 
     # -- breaker transitions ----------------------------------------------
 
+    def _record_strike_locked(self, idx: int, kind: str, detail: str) -> None:
+        self._strikes.append({
+            "t": round(time.time(), 3),
+            "device": idx,
+            "kind": kind,
+            "detail": detail[:200],
+        })
+
+    def strike_history(self) -> list:
+        """The /debugz strike ring: quarantine-grade events, oldest
+        first (crash trips, corruption strikes, fail-slow transitions)."""
+        with self._lock:
+            return list(self._strikes)
+
     def note_failure(self, idx: int, err: object = None) -> bool:
         """Book one failed dispatch/drain EVENT against device `idx`;
         returns whether this failure tripped (or re-tripped) its breaker."""
@@ -163,8 +268,42 @@ class DeviceHealthRegistry:
                 rec.quarantined_until = now + self.cooldown_s
                 rec.breaker_opens += 1
                 self.generation += 1
+                self._record_strike_locked(idx, "crash", str(err or ""))
                 return True
             return False
+
+    def note_corruption(self, idx: int, err: object = None,
+                        clean_probes: Optional[int] = None) -> bool:
+        """Book one CORRUPTION strike (wrong bytes, not a crash) against
+        device `idx`. Quarantines faster than crash strikes — instantly,
+        no three-strike debate: a chip that computes wrong answers while
+        reporting success is the one failure mode that silently reaches
+        clients — and poisons re-admission until `clean_probes`
+        consecutive clean golden probes (note_probe_ok). Returns whether
+        this strike newly opened the quarantine."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._records[idx]
+            rec.corruptions += 1
+            rec.failures += 1
+            rec.error_ewma = 0.8 * rec.error_ewma + 0.2
+            if err is not None:
+                rec.last_error = str(err)[:200]
+            # threshold-1 consecutive + the breaker rule: one more failure
+            # in the half-open window re-opens instantly, same as a trip
+            rec.consecutive_failures = max(rec.consecutive_failures,
+                                           self.threshold)
+            rec.clean_probes_needed = max(
+                rec.clean_probes_needed,
+                max(1, int(clean_probes if clean_probes is not None
+                           else self.corruption_clean_probes)))
+            tripped = now >= rec.quarantined_until
+            rec.quarantined_until = now + self.cooldown_s
+            if tripped:
+                rec.breaker_opens += 1
+            self.generation += 1
+            self._record_strike_locked(idx, "corruption", str(err or ""))
+            return tripped
 
     def note_capacity(self, idx: int, err: object = None) -> None:
         """Book one OOM/RESOURCE_EXHAUSTED event against device `idx` as
@@ -183,16 +322,158 @@ class DeviceHealthRegistry:
             was_open = rec.quarantined_until > 0.0
             rec.consecutive_failures = 0
             rec.quarantined_until = 0.0
+            # a request-path success IS the probe on a 1-device registry
+            # (PR 4 half-open semantics); it clears the clean-probe debt
+            # too — with no peer to fail over to, withholding re-admission
+            # would withhold the only capacity there is
+            rec.clean_probes_needed = 0
             rec.successes += 1
             rec.error_ewma *= 0.8
             if was_open:
                 rec.readmissions += 1
                 self.generation += 1
+                if self._fs_ratio > 0.0:
+                    # a re-admitted chip re-earns latency trust from zero:
+                    # its pre-quarantine EWMAs described the sick chip
+                    rec.latency_ewma_ms = None
+                    rec.latency_samples = 0
+                    rec.probe_latency_ewma_ms = None
+                    rec.probe_latency_samples = 0
+                    rec.degraded = False
+                    rec.slow_strikes = 0
             if latency_ms is not None:
+                # None-sentinel seeding: a genuine 0.0 ms first sample
+                # seeds once and never re-seeds (the == 0.0 check it
+                # replaces re-seeded forever)
                 rec.latency_ewma_ms = (
-                    latency_ms if rec.latency_ewma_ms == 0.0
+                    latency_ms if rec.latency_ewma_ms is None
                     else 0.8 * rec.latency_ewma_ms + 0.2 * latency_ms
                 )
+                rec.latency_samples += 1
+
+    def _peer_probe_median_locked(self, rec: DeviceRecord) -> Optional[float]:
+        """Median of the PEERS' probe-latency EWMAs (each peer needing
+        min_samples), or None when no peer qualifies — the single-device
+        degeneration and the cold-fleet hysteresis in one check."""
+        peers = sorted(
+            r.probe_latency_ewma_ms for r in self._records
+            if r is not rec and r.probe_latency_ewma_ms is not None
+            and r.probe_latency_samples >= self._fs_min_samples)
+        if not peers:
+            return None
+        med = peers[len(peers) // 2]
+        return med if med > 0.0 else None
+
+    def _failslow_recovered_locked(self, rec: DeviceRecord) -> bool:
+        """Re-admission gate for an OPEN record when fail-slow is armed:
+        its probe EWMA must sit under the readmit bar (half the demotion
+        threshold) — a correct-but-still-limping probe must not close
+        the breaker. Records without enough samples (fresh, or just
+        reset) and fleets without peers pass: crash-quarantine semantics
+        must not change when the latency signal has nothing to say."""
+        if self._fs_ratio <= 0.0:
+            return True
+        if rec.probe_latency_samples < self._fs_min_samples:
+            return True
+        med = self._peer_probe_median_locked(rec)
+        if med is None:
+            return True
+        return rec.probe_latency_ewma_ms <= self._fs_ratio * med * 0.5
+
+    def _book_probe_latency_locked(self, rec: DeviceRecord,
+                                   latency_ms: Optional[float]) -> None:
+        if latency_ms is None:
+            return
+        rec.probe_latency_ewma_ms = (
+            latency_ms if rec.probe_latency_ewma_ms is None
+            else 0.8 * rec.probe_latency_ewma_ms + 0.2 * latency_ms
+        )
+        rec.probe_latency_samples += 1
+        if self._fs_ratio > 0.0:
+            self._eval_failslow_locked(rec, time.monotonic())
+
+    def note_probe_ok(self, idx: int, latency_ms: Optional[float] = None) -> None:
+        """A clean golden probe. Books the probe-latency EWMA (the
+        fail-slow comparison's signal — see DeviceRecord) and runs the
+        demotion evaluation; decrements the corruption clean-probe debt,
+        and only the probe that clears the debt re-admits (note_ok): a
+        mercurial core must not re-enter on one lucky run."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._records[idx]
+            self._book_probe_latency_locked(rec, latency_ms)
+            if now < rec.quarantined_until:
+                # the latency eval just failslow-quarantined this device
+                # (or the cooldown is still running): a clean probe must
+                # not close a breaker that hasn't cooled down
+                return
+            if rec.quarantined_until > 0.0 and not self._failslow_recovered_locked(rec):
+                # half-open but still probing slow: correctness alone
+                # does not re-admit a limping chip — its probe EWMA must
+                # first recover through the readmit bar
+                return
+            if rec.clean_probes_needed > 1:
+                rec.clean_probes_needed -= 1
+                return
+        # probe latency stays out of the production EWMA: the two
+        # measure different workloads and must not blend
+        self.note_ok(idx, latency_ms=None)
+
+    def _eval_failslow_locked(self, rec: DeviceRecord, now: float) -> None:
+        """Demote/readmit/quarantine on the golden-probe latency signal
+        (holding the lock; called on every probe sample when a ratio is
+        armed). The comparison baseline is the median of the PEERS'
+        probe EWMAs — with two devices a self-inclusive median would
+        average the limper into its own threshold and never trip — and a
+        fleet of one has no peers, so the whole evaluation degenerates
+        to a no-op by construction."""
+        if rec.quarantined_until > 0.0:
+            # already quarantined/half-open: booking the EWMA is enough —
+            # new demotions or strikes against an out-of-rotation chip
+            # are churn, and re-admission consults
+            # _failslow_recovered_locked instead
+            return
+        med = self._peer_probe_median_locked(rec)
+        if med is None:
+            return
+        ewma = rec.probe_latency_ewma_ms
+        if rec.probe_latency_samples < self._fs_min_samples:
+            return
+        if not rec.degraded:
+            if ewma > self._fs_ratio * med:
+                rec.degraded = True
+                rec.demotions += 1
+                rec.slow_strikes = 0
+                self.generation += 1
+                self._record_strike_locked(
+                    rec.idx, "failslow_demote",
+                    f"latency {ewma:.1f}ms vs peer median {med:.1f}ms")
+            return
+        if ewma <= self._fs_ratio * med * 0.5:
+            # re-admission hysteresis at half the demotion bar: a chip
+            # hovering exactly at the threshold must not flap
+            rec.degraded = False
+            rec.slow_strikes = 0
+            self.generation += 1
+            return
+        if ewma > self._fs_ratio * med:
+            rec.slow_strikes += 1
+            if rec.slow_strikes >= self._fs_strikes:
+                # keeps slipping: full quarantine; the golden probe owns
+                # re-admission (and note_ok's was_open branch resets the
+                # latency trust it re-enters with)
+                if now >= rec.quarantined_until:
+                    rec.breaker_opens += 1
+                rec.quarantined_until = now + self.cooldown_s
+                rec.consecutive_failures = max(rec.consecutive_failures,
+                                               self.threshold)
+                rec.failslow_quarantines += 1
+                rec.degraded = False
+                rec.slow_strikes = 0
+                self.generation += 1
+                self._record_strike_locked(
+                    rec.idx, "failslow_quarantine",
+                    f"latency {ewma:.1f}ms vs peer median {med:.1f}ms")
 
     def set_consecutive(self, idx: int, n: int) -> None:
         """Preload the consecutive count (the drain watchdog's 'a 20 s
@@ -239,9 +520,25 @@ class DeviceHealthRegistry:
         every device is hard-quarantined or excluded."""
         now = time.monotonic()
         with self._lock:
-            for r in self._records:
-                if r.state(now) == STATE_HEALTHY and r.idx not in exclude:
-                    return r.idx
+            healthy = [r for r in self._records
+                       if r.state(now) == STATE_HEALTHY and r.idx not in exclude]
+            degraded = [r for r in self._records
+                        if r.state(now) == STATE_DEGRADED and r.idx not in exclude]
+            if degraded and healthy and self._fs_share > 0.0:
+                # weighted dispatch for fail-slow demotion: a degraded
+                # chip keeps `share` of its rotation (every round(1/share)
+                # picks) so its latency keeps being measured; at the
+                # default share 0 it sheds everything and recovery rides
+                # the golden probe alone
+                self._pick_tick += 1
+                if self._pick_tick % max(2, round(1.0 / self._fs_share)) == 0:
+                    return degraded[0].idx
+            if healthy:
+                return healthy[0].idx
+            if degraded:
+                # limping beats quarantined: a degraded chip still serves
+                # when nothing strictly-healthy remains
+                return degraded[0].idx
             for r in self._records:
                 if now >= r.quarantined_until and r.idx not in exclude:
                     return r.idx
@@ -249,16 +546,22 @@ class DeviceHealthRegistry:
 
     def due_for_probe(self) -> list:
         """Half-open devices whose cooldown elapsed and whose last probe
-        is at least a cooldown old — the probe loop's work list."""
+        is at least a cooldown old — the probe loop's work list. When
+        fail-slow demotion is armed, EVERY device is probed on the same
+        cadence: the demotion judgment compares golden-probe latencies
+        across devices (see DeviceRecord.probe_latency_ewma_ms), so the
+        healthy fleet must keep producing its baseline — and a degraded
+        device, its production share shed, recovers (or quarantines)
+        purely on this probe stream."""
         now = time.monotonic()
         out = []
         with self._lock:
             for r in self._records:
-                if (
-                    r.quarantined_until > 0.0
-                    and now >= r.quarantined_until
-                    and now - r.last_probe_t >= min(1.0, self.cooldown_s)
-                ):
+                if now - r.last_probe_t < min(1.0, self.cooldown_s):
+                    continue
+                if r.quarantined_until > 0.0 and now >= r.quarantined_until:
+                    out.append(r.idx)
+                elif self._fs_ratio > 0.0:
                     out.append(r.idx)
         return out
 
@@ -274,6 +577,8 @@ class DeviceHealthRegistry:
             "count": len(per),
             "healthy": healthy,
             "quarantined": quarantined,
+            "degraded": sum(1 for d in per if d["state"] == STATE_DEGRADED),
+            "corruptions": sum(d["corruptions"] for d in per),
             "per_device": per,
         }
 
@@ -304,8 +609,16 @@ class DeviceHealthRegistry:
                     def attempt(i=idx):
                         try:
                             t0 = time.monotonic()
-                            probe_fn(i)
-                            outcome["ms"] = (time.monotonic() - t0) * 1000.0
+                            ret = probe_fn(i)
+                            # a probe_fn may return its own latency (the
+                            # golden probe re-times a warm run when its
+                            # first run paid an XLA compile — booking
+                            # compile time as chip latency transiently
+                            # fail-slow-demoted healthy chips); wall
+                            # clock remains the fallback contract
+                            outcome["ms"] = (
+                                float(ret) if isinstance(ret, (int, float))
+                                else (time.monotonic() - t0) * 1000.0)
                         except Exception as e:  # noqa: BLE001 - probe is a boundary
                             outcome["err"] = e
 
@@ -314,10 +627,20 @@ class DeviceHealthRegistry:
                     t.start()
                     t.join(timeout=timeout_s)
                     if t.is_alive() or "err" in outcome:
-                        self.note_failure(
-                            idx, outcome.get("err", "probe hang"))
+                        err = outcome.get("err", "probe hang")
+                        if isinstance(err, CorruptionError):
+                            # the golden chain ran to completion and the
+                            # BYTES were wrong: corruption strike, not a
+                            # crash — instant re-quarantine plus the
+                            # clean-probe re-admission debt
+                            self.note_corruption(idx, err)
+                        else:
+                            self.note_failure(idx, err)
                     else:
-                        self.note_ok(idx, latency_ms=outcome.get("ms"))
+                        # note_probe_ok, not note_ok: a corruption-struck
+                        # device re-admits only after its clean-probe debt
+                        # is paid down, one clean golden run at a time
+                        self.note_probe_ok(idx, latency_ms=outcome.get("ms"))
 
         self._probe_thread = threading.Thread(
             target=loop, name="itpu-devprobe", daemon=True)

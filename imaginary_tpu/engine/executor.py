@@ -213,6 +213,24 @@ class ExecutorConfig:
     # host interpreter (or surface the device error for host-inexecutable
     # plans). 3 levels turns a 16-item chunk into singles.
     oom_split_depth: int = 3
+    # Output-integrity defense (engine/integrity.IntegrityState). When
+    # set AND enabled: the devhealth probe runs the golden canary chain
+    # instead of device_put+add, a sampled fraction of device chunks is
+    # recomputed on the host (or a peer chip) and compared before
+    # release — mismatch = corruption strike + transparent re-serve from
+    # the verified copy — and deterministic non-OOM chunk failures are
+    # bisected to convict poison inputs into a digest quarantine list.
+    # None (the default) is the parity path: no digest, no sample, no
+    # golden run ever happens.
+    integrity: Optional[object] = None
+    # Fail-slow demotion (engine/devhealth.configure_failslow): demote a
+    # device whose latency EWMA exceeds failslow_ratio x the median of
+    # its peers' EWMAs (each needing failslow_min_samples samples) to a
+    # degraded state that keeps only failslow_share of its dispatch
+    # rotation. 0 = off (parity: the EWMA is recorded, never consulted).
+    failslow_ratio: float = 0.0
+    failslow_min_samples: int = 8
+    failslow_share: float = 0.0
 
 
 @dataclasses.dataclass
@@ -499,6 +517,16 @@ class Executor:
         self.devhealth = DeviceHealthRegistry(
             1, threshold=self.config.breaker_threshold,
             cooldown_s=self.config.breaker_cooldown_s)
+        # output-integrity state (engine/integrity.py); None = parity
+        self.integrity = self.config.integrity
+        if self.integrity is not None:
+            self.devhealth.corruption_clean_probes = (
+                self.integrity.config.clean_probes)
+        if self.config.failslow_ratio > 0.0:
+            self.devhealth.configure_failslow(
+                self.config.failslow_ratio,
+                min_samples=self.config.failslow_min_samples,
+                share=self.config.failslow_share)
         self._devices: Optional[list] = None  # resolved at first dispatch
         self._mesh = None
         if self._sharding is not None:
@@ -507,7 +535,8 @@ class Executor:
             self._devices = list(self._mesh.devices.flat)
             self.devhealth.resize(len(self._devices))
             if len(self._devices) > 1:
-                self.devhealth.start_probing(self._probe_device)
+                self.devhealth.start_probing(self._probe_device,
+                                             timeout_s=self._probe_timeout_s())
         self._devhealth_gen = 0
         # in-flight device items + live hedge count (the hedge budget's
         # denominator/numerator), guarded by _owed_lock
@@ -643,6 +672,10 @@ class Executor:
             # per-device fault domains (engine/devhealth.py): the same
             # block /health serves as `devices`
             "devices": self.devhealth.snapshot(),
+            # quarantine-grade events, oldest first: crash trips,
+            # corruption strikes, fail-slow demotions/quarantines — the
+            # "why did this chip leave the rotation" audit trail
+            "strike_history": self.devhealth.strike_history(),
             "hedges_inflight": hedges_inflight,
             "device_items_inflight": device_items,
             "rate_keys": rate_keys,
@@ -656,6 +689,10 @@ class Executor:
         if self.config.qos is not None:
             # per-class intake depth (the fair scheduler's live view)
             snap["qos_queued"] = self._queue.depths()
+        if self.integrity is not None:
+            # verification counters + poison-list occupancy (the same
+            # block /health serves as `integrity`)
+            snap["integrity"] = self.integrity.snapshot()
         return snap
 
     def submit(self, arr: np.ndarray, plan: ImagePlan) -> Future:
@@ -683,6 +720,39 @@ class Executor:
             if not item.future.done():
                 item.future.set_result(arr)
             return item.future
+        integ = self.integrity
+        if integ is not None and integ.enabled and integ.poison_active():
+            # poison quarantine list (engine/integrity.py): an input the
+            # bisect convicted of failing device execution IN ISOLATION
+            # routes straight to the host instead of re-poisoning every
+            # batch it would join; host-inexecutable plans answer 422.
+            # The digest is only ever computed while the list is
+            # non-empty (poison_active), so the clean hot path pays one
+            # truthiness check.
+            from imaginary_tpu.engine import integrity as integrity_mod
+
+            if integ.poison_hit(integrity_mod.item_digest(arr, item.key)):
+                if host_exec.can_execute(plan, for_spill=False):
+                    try:
+                        out = host_exec.run(arr, plan)
+                    # itpu: allow[ITPU004] host routing is best-effort; the 422 below is the honest fallback
+                    except Exception:
+                        pass
+                    else:
+                        _PLACEMENT.value = "host"
+                        self._stamp_attempts(
+                            [item], ["poison_quarantine", "host_fallback"])
+                        if not item.future.done():
+                            item.future.set_result(out)
+                        return item.future
+                from imaginary_tpu.errors import new_error
+
+                self._stamp_attempts([item], ["poison_quarantine"])
+                if not item.future.done():
+                    item.future.set_exception(new_error(
+                        "Input is quarantined: it repeatedly failed device "
+                        "execution in isolation", 422))
+                return item.future
         if self._breaker_is_open() and host_exec.can_execute(plan, for_spill=False):
             # device outage: serve from the host interpreter rather than
             # 400-ing. ALL host-executable traffic fails over together, so
@@ -905,20 +975,88 @@ class Executor:
         self._devices = devs
         if len(devs) > 1:
             self.devhealth.resize(len(devs))
-            self.devhealth.start_probing(self._probe_device)
+            self.devhealth.start_probing(self._probe_device,
+                                         timeout_s=self._probe_timeout_s())
+
+    def _probe_timeout_s(self) -> float:
+        """Join budget for one probe attempt. The golden canary chain's
+        FIRST run on a device pays an XLA compile (per-device placement
+        keys the compile cache), which the 5 s transfer-probe budget
+        would misread as a hang — booking a failure per probe forever."""
+        if self.integrity is not None and self.integrity.enabled:
+            return 30.0
+        if self.config.failslow_ratio > 0.0:
+            return 30.0
+        return 5.0
+
+    def _golden_probe_armed(self) -> bool:
+        """The golden canary replaces the transfer probe when integrity
+        is on (corruption detection needs a real op-chain) or fail-slow
+        demotion is armed (degraded devices are judged on the timed
+        golden run, not on a bytes-free add)."""
+        if self.integrity is not None and self.integrity.enabled:
+            return True
+        return self.config.failslow_ratio > 0.0
 
     def _probe_device(self, idx: int) -> None:
-        """Half-open re-admission probe: a tiny computation pinned to
-        device `idx`, raising on failure. Runs the chip_error failpoint
-        too — an injected sick chip must fail its probe exactly as a real
-        one would, or chaos runs would re-admit mid-fault and flap."""
+        """Half-open re-admission probe, raising on failure. Two modes:
+
+        Parity (integrity + fail-slow off): the PR 6 transfer probe — a
+        tiny device_put+add pinned to device `idx`.
+
+        Golden canary (either armed): run the golden resize chain
+        (prewarm.golden_case) on device `idx` and compare the output
+        against the boot-time host reference; wrong bytes raise
+        CorruptionError, which the probe loop books as a corruption
+        strike — so a chip corrupting its compute units cannot pass
+        re-admission by moving bytes correctly. Runs the chip_error,
+        slow, and corrupt failpoints so chaos faults hold through the
+        probe cycle instead of flapping re-admission mid-fault. Returns
+        the timed WARM golden-run milliseconds (compile-contaminated
+        first runs are re-timed) — the probe loop books that instead of
+        its own wall clock — or None for the parity probe."""
         failpoints.hit("device.chip_error", key=idx)
         import jax
 
         devs = self._devices
         dev = devs[idx] if devs and idx < len(devs) else None
+        if self._golden_probe_armed():
+            from imaginary_tpu.engine import integrity as integrity_mod
+            from imaginary_tpu.engine.devhealth import CorruptionError
+
+            arr, plan, ref = integrity_mod.golden()
+            cache_before = chain_mod.cache_size()
+            t0 = time.monotonic()
+            failpoints.hit("device.slow", key=idx)
+            out = chain_mod.run_batch([arr], [plan], device=dev)[0]
+            ms = (time.monotonic() - t0) * 1000.0
+            if chain_mod.cache_size() > cache_before:
+                # the first golden run on a device pays an XLA compile
+                # (per-device placement keys the cache): re-time a WARM
+                # run so the returned latency prices the chip, not the
+                # compiler — a compile-seeded probe EWMA transiently
+                # fail-slow-demoted healthy chips (caught by /verify)
+                t0 = time.monotonic()
+                failpoints.hit("device.slow", key=idx)
+                out = chain_mod.run_batch([arr], [plan], device=dev)[0]
+                ms = (time.monotonic() - t0) * 1000.0
+            try:
+                failpoints.hit("device.corrupt", key=idx)
+            except failpoints.FailpointError:
+                out = integrity_mod.corrupt_copy(out)
+            integ = self.integrity
+            tol = integ.config.tolerance if integ is not None else 96
+            mean_tol = integ.config.mean_tolerance if integ is not None else 16.0
+            if not integrity_mod.outputs_match(out, ref, exact=False, tol=tol,
+                                               mean_tol=mean_tol):
+                raise CorruptionError(
+                    f"golden probe mismatch on device {idx}: checksum "
+                    f"{chain_mod.output_checksum(out):#010x} vs reference "
+                    f"{chain_mod.output_checksum(ref):#010x}")
+            return ms
         x = jax.device_put(np.zeros((8,), np.float32), dev)
         (x + 1.0).block_until_ready()
+        return None
 
     @staticmethod
     def _stamp_attempts(items: list, attempts: list) -> None:
@@ -1417,6 +1555,7 @@ class Executor:
             # failure is not attributable to one of them, so all current
             # domains take the strike (a 1-chip mesh reduces to PR 4)
             self._refresh_mesh_sharding()
+            t_launch = time.monotonic()
             try:
                 failpoints.hit("device.chip_error")
                 failpoints.hit("device.oom")
@@ -1426,7 +1565,7 @@ class Executor:
                     # capacity, not fault: bisect-retry unsharded on the
                     # default device (re-sharding a launch that just
                     # overflowed the mesh would overflow it again)
-                    self._recover_oom_chunk(sub, None, None, e)
+                    self._bisect_chunk(sub, None, None, e)
                     return None
                 self._note_link_failure(e)
                 self._stamp_attempts(sub, ["device:mesh:error"])
@@ -1435,7 +1574,7 @@ class Executor:
                         it.future.set_exception(e)
                 return None
             self._stamp_attempts(sub, ["device:mesh"])
-            return (y, arrs, plans, sub, None)
+            return (y, arrs, plans, sub, None, t_launch)
         multi = self._devices is not None and len(self._devices) > 1
         tried: set = set()
         attempts: list = []
@@ -1459,19 +1598,38 @@ class Executor:
             # a failover launch pays its own (cold-detected) compile only
             # during an actual outage.
             dev = self._devices[idx] if multi and idx != 0 else None
+            # Per-chunk launch stamp: the fetcher books THIS device's
+            # latency EWMA from launch to drain completion, which is what
+            # makes the fail-slow comparison per-device — the old
+            # drain-averaged booking gave every drained device the same
+            # number, and a limping chip hid inside its healthy peers'
+            # average.
+            t_launch = time.monotonic()
             try:
                 # chaos sites, keyed by device index: chip_error[k] kills
                 # chip k specifically while its peers keep serving;
-                # oom[k] simulates chip k's allocator at its ceiling
+                # oom[k] simulates chip k's allocator at its ceiling;
+                # slow[k] (a delay action) is the limping chip — it
+                # inflates exactly the per-chunk latency the fail-slow
+                # demotion judges
                 failpoints.hit("device.chip_error", key=idx)
                 failpoints.hit("device.oom", key=idx)
+                failpoints.hit("device.slow", key=idx)
                 y, arrs, plans = self._launch_chunk(sub, device=dev)
             except Exception as e:
                 if chain_mod.is_oom_error(e):
                     # capacity, not fault: the chunk didn't fit — bisect
                     # and retry ON THIS device (no breaker strike, no
                     # failover; the chip is healthy, the batch was big)
-                    self._recover_oom_chunk(sub, dev, idx, e)
+                    self._bisect_chunk(sub, dev, idx, e)
+                    return None
+                integ = self.integrity
+                if (integ is not None and integ.enabled and len(sub) > 1
+                        and self._poison_bisect(sub, dev, idx, e)):
+                    # the bisect attributed the failure to specific
+                    # INPUTS (siblings succeeded on this same chip):
+                    # futures are resolved, the poison digests recorded,
+                    # and no fault domain takes a strike
                     return None
                 err = e
                 self._note_device_failure(idx, e)
@@ -1479,10 +1637,26 @@ class Executor:
                 continue
             attempts.append(f"device:{idx}")
             self._stamp_attempts(sub, attempts)
-            return (y, arrs, plans, sub, idx)
+            return (y, arrs, plans, sub, idx, t_launch)
         self._stamp_attempts(sub, attempts)
         e = err if err is not None else RuntimeError(
             "no dispatchable device (all fault domains quarantined)")
+        integ = self.integrity
+        errored = sum(1 for a in attempts if a.endswith(":error"))
+        if integ is not None and integ.enabled and errored >= 2:
+            # TWO OR MORE independent fault domains rejected these items:
+            # for a deterministic poison input that is its signature (a
+            # single sick chip fails alone; its healthy peer would have
+            # served). Record the digests so the NEXT submit of the same
+            # input routes straight to host/422 instead of walking (and
+            # striking) the ladder again. A 1-device ladder never gets
+            # here with two errors, so a lone chip fault can't convict
+            # innocent inputs.
+            from imaginary_tpu.engine import integrity as integrity_mod
+
+            for it in sub:
+                if not it.future.done():
+                    integ.poison_add(integrity_mod.item_digest(it.arr, it.key))
         for it in sub:
             # done() covers deadline-cancelled futures: set_exception on
             # a cancelled future raises InvalidStateError and would kill
@@ -1580,10 +1754,24 @@ class Executor:
                 self.stats.pressure_capped_batches += len(subs) - base
         return subs
 
-    # -- OOM-recovering execution (memory-pressure subsystem) ------------------
+    # -- bisecting batch-fault recovery ----------------------------------------
+    #
+    # Two fault classes share the split-and-retry shape but nothing else:
+    #   * OOM (capacity): retry halves on the SAME device, recurse to
+    #     oom_split_depth, host-route the stragglers — the PR 7 behavior,
+    #     unchanged byte for byte (_bisect_chunk below).
+    #   * deterministic non-OOM errors (poison inputs): bisect to convict
+    #     the specific INPUT, serve its innocent siblings, and record the
+    #     convict's digest in the integrity quarantine list so it can
+    #     never re-poison another batch (_poison_bisect; integrity-gated).
 
     def _recover_oom_chunk(self, items: list, device, idx, err,
                            depth: int = 0) -> None:
+        """Back-compat alias: the OOM mode of the generalized bisect."""
+        self._bisect_chunk(items, device, idx, err, depth)
+
+    def _bisect_chunk(self, items: list, device, idx, err,
+                      depth: int = 0) -> None:
         """Bisect-retry a chunk that RESOURCE_EXHAUSTED: split in half,
         relaunch each half SYNCHRONOUSLY on the same device (the failure
         was capacity, not the chip — moving would only spread the
@@ -1622,8 +1810,8 @@ class Executor:
                         device=device)
                 except Exception as e:
                     if chain_mod.is_oom_error(e):
-                        self._recover_oom_chunk(half, device, idx, e,
-                                                depth + 1)
+                        self._bisect_chunk(half, device, idx, e,
+                                           depth + 1)
                     else:
                         for it in half:
                             if not it.future.done():
@@ -1663,6 +1851,180 @@ class Executor:
                 it.future.set_exception(
                     err if isinstance(err, Exception)
                     else RuntimeError("device out of memory"))
+
+    def _poison_bisect(self, items: list, device, idx, err) -> bool:
+        """Deterministic-error mode of the bisect (integrity-gated): a
+        chunk failed a non-OOM launch — re-run its halves on the SAME
+        device down to singles to decide whether the failure follows an
+        INPUT (a poison request) or the chip.
+
+        Returns True when at least one item succeeded in isolation: the
+        failure is input-attributable, so the survivors' futures are
+        resolved, each convicted input's digest lands in the poison
+        quarantine list (routing its retries straight to host/422), the
+        convicts themselves are host-routed where possible, and NO fault
+        domain takes a strike — a poison input must never convert a
+        healthy chip into an outage. Returns False with every future
+        untouched when nothing succeeded (the chip, not the inputs): the
+        caller's failover ladder then strikes and retries exactly as it
+        would have without the bisect."""
+        didx = idx if idx is not None else 0
+        oks, bads = [], []
+        mid = (len(items) + 1) // 2
+        for half in (items[:mid], items[mid:]):
+            if half:
+                o, b = self._poison_probe(half, device, didx)
+                oks.extend(o)
+                bads.extend(b)
+        if not oks:
+            return False
+        from imaginary_tpu.engine import integrity as integrity_mod
+
+        integ = self.integrity
+        for it, out in oks:
+            self._stamp_attempts([it], [f"device:{didx}:poison_bisect",
+                                        f"device:{didx}"])
+            if not it.future.done():
+                it.future.set_result(out)
+        for it, e in bads:
+            integ.poison_add(integrity_mod.item_digest(it.arr, it.key))
+            if host_exec.can_execute(it.plan, for_spill=False):
+                try:
+                    out = host_exec.run(it.arr, it.plan)
+                # itpu: allow[ITPU004] host routing is best-effort; the error path below surfaces the device error
+                except Exception:
+                    pass
+                else:
+                    self._stamp_attempts(
+                        [it], [f"device:{didx}:poison_bisect",
+                               "poison_quarantine", "host_fallback"])
+                    # placement override for the response header: these
+                    # pixels came from the host interpreter (the same
+                    # flag the hedge winner and OOM host-routing use)
+                    it.future._hedge_placement = "host"
+                    if not it.future.done():
+                        it.future.set_result(out)
+                    continue
+            self._stamp_attempts(
+                [it], [f"device:{didx}:poison_bisect", "poison_quarantine"])
+            if not it.future.done():
+                it.future.set_exception(e)
+        return True
+
+    def _poison_probe(self, items: list, device, didx: int) -> tuple:
+        """Recursive half of _poison_bisect: run `items` as one launch on
+        the same device; on failure split down to singles. Returns
+        (oks, bads) as [(item, output)] / [(item, error)] WITHOUT
+        touching any future — the caller commits or rolls back based on
+        the whole chunk's verdict. Re-runs the keyed chip_error failpoint
+        so an injected chip fault fails every retry level exactly as a
+        real dead chip would (no false input convictions under chaos)."""
+        try:
+            failpoints.hit("device.chip_error", key=didx)
+            outs = chain_mod.run_batch(
+                [it.arr for it in items], [it.plan for it in items],
+                device=device)
+        except Exception as e:
+            if len(items) == 1:
+                return [], [(items[0], e)]
+            mid = (len(items) + 1) // 2
+            ok1, bad1 = self._poison_probe(items[:mid], device, didx)
+            ok2, bad2 = self._poison_probe(items[mid:], device, didx)
+            return ok1 + ok2, bad1 + bad2
+        return list(zip(items, outs)), []
+
+    # -- sampled cross-verification (output-integrity defense) -----------------
+
+    def _note_corruption(self, idx, err) -> None:
+        """Book a corruption strike (wrong bytes) against device `idx`'s
+        fault domain — or, for an unattributable mesh chunk, against
+        every dispatchable domain (the conservative read, mirroring
+        _note_link_failure). Counts toward stats.device_failures and the
+        fleet-outage counter exactly like a crash strike."""
+        idxs = [idx] if idx is not None else (
+            self.devhealth.available_indices() or [0])
+        clean = (self.integrity.config.clean_probes
+                 if self.integrity is not None else 3)
+        for didx in idxs:
+            tripped = self.devhealth.note_corruption(didx, err,
+                                                     clean_probes=clean)
+            with self._owed_lock:
+                self.stats.device_failures += 1
+                if tripped and not self.devhealth.any_available():
+                    self.stats.breaker_opens += 1
+
+    def _verify_reference(self, it: "_Item", idx) -> tuple:
+        """Recompute one item's output on an independent substrate:
+        (reference, exact). The host interpreter is preferred — its
+        comparison is tolerance-bounded (PSNR-equivalent kernels, see
+        engine/integrity.py) — else a second dispatchable chip runs the
+        same compiled program and compares EXACTLY. (None, False) when
+        neither path exists; the caller counts the skip."""
+        if host_exec.can_execute(it.plan, for_spill=False):
+            try:
+                return host_exec.run(it.arr, it.plan), False
+            # itpu: allow[ITPU004] verification is best-effort; a failed recompute counts as a skip, never a 500
+            except Exception:
+                pass
+        devs = self._devices
+        if devs and len(devs) > 1:
+            other = self.devhealth.pick(
+                exclude={idx} if idx is not None else set())
+            if other is not None and other != idx and other < len(devs):
+                dev = devs[other] if other != 0 else None
+                try:
+                    return chain_mod.run_batch(
+                        [it.arr], [it.plan], device=dev)[0], True
+                # itpu: allow[ITPU004] verification is best-effort; a failed recompute counts as a skip, never a 500
+                except Exception:
+                    pass
+        return None, False
+
+    def _verify_chunk(self, sub: list, outs: list, idx) -> set:
+        """Sampled cross-verification: when this chunk draws the sample
+        (integrity.should_sample, a deterministic 1-in-round(1/sample)
+        counter), recompute each live item independently and compare
+        BEFORE the response is released. A mismatch books a corruption
+        strike against the serving device and the item is transparently
+        re-served from the verified copy — `outs` is patched in place and
+        the returned set names the indices whose verified copy came from
+        the HOST (their responses must carry X-Imaginary-Backend: host).
+        Runs on the fetcher thread: blocking here is the point — the
+        corrupted bytes must never leave the process."""
+        integ = self.integrity
+        if integ is None or not integ.enabled or not integ.should_sample():
+            return set()
+        from imaginary_tpu.engine import integrity as integrity_mod
+        from imaginary_tpu.engine.devhealth import CorruptionError
+
+        host_served: set = set()
+        mismatched = False
+        for i, (it, out) in enumerate(zip(sub, outs)):
+            if it.future.done():
+                continue  # cancelled/expired: nothing will be released
+            ref, exact = self._verify_reference(it, idx)
+            if ref is None:
+                integ.note_skipped()
+                continue
+            integ.note_check()
+            if integrity_mod.outputs_match(
+                    out, ref, exact=exact, tol=integ.config.tolerance,
+                    mean_tol=integ.config.mean_tolerance):
+                continue
+            mismatched = True
+            integ.note_mismatch()
+            # the reference IS the verified copy: host recomputes are
+            # ground truth by construction, and a peer chip's exact
+            # recompute is the copy the suspect chip failed to match
+            outs[i] = ref
+            integ.note_reserved()
+            if not exact:
+                host_served.add(i)
+        if mismatched:
+            self._note_corruption(idx, CorruptionError(
+                "sampled cross-verification mismatch "
+                f"(device {idx if idx is not None else 'mesh'})"))
+        return host_served
 
     def _watchdog_loop(self):
         """Abandon drains stuck past drain_watchdog_s (see ExecutorConfig).
@@ -1825,13 +2187,23 @@ class Executor:
                 # the queue — discard the zombie results and exit without
                 # touching the breaker, the EWMAs, or inflight
                 return
-            drained_idxs = sorted({c[4] for c in chunks if c[4] is not None})
-            if not drained_idxs:
-                drained_idxs = self.devhealth.available_indices() or [0]
-            ok_latency = ((time.monotonic() - t0) * 1000.0
-                          / max(1, len(chunks)))
-            for idx in drained_idxs:
-                self._note_device_ok(idx, latency_ms=ok_latency)
+            # Per-chunk latency, launch -> drain completion, booked to the
+            # chunk's OWN device (c[5] is the launch stamp): this is the
+            # signal fail-slow demotion consults — the old drain-averaged
+            # booking handed every device the same number, so a limping
+            # chip hid inside its healthy peers' average. Mesh chunks
+            # (idx None) keep the averaged fleet-wide booking.
+            now_ok = time.monotonic()
+            booked_any = False
+            for c in chunks:
+                if c[4] is not None:
+                    self._note_device_ok(
+                        c[4], latency_ms=(now_ok - c[5]) * 1000.0)
+                    booked_any = True
+            if not booked_any:
+                ok_latency = (now_ok - t0) * 1000.0 / max(1, len(chunks))
+                for idx in (self.devhealth.available_indices() or [0]):
+                    self._note_device_ok(idx, latency_ms=ok_latency)
             # A drain costs fixed + MB x rate (the link's round-trip floor
             # plus bandwidth). The per-MB estimator must book only the
             # BANDWIDTH part: subtract the learned fixed floor — the
@@ -1894,7 +2266,7 @@ class Executor:
                         else:
                             k = min(per_mb, 4.0 * kprev)
                             self._rate_by_key[key] = 0.7 * kprev + 0.3 * k
-            for host_y, (y, arrs, plans, sub, _idx) in zip(fetched, chunks):
+            for host_y, (y, arrs, plans, sub, cidx, _tl) in zip(fetched, chunks):
                 try:
                     outs = chain_mod.finish_batch(host_y, arrs, plans)
                 except Exception as e:
@@ -1902,7 +2274,25 @@ class Executor:
                         if not it.future.done():
                             it.future.set_exception(e)
                     continue
-                for it, out in zip(sub, outs):
+                # chaos site: an armed device.corrupt[k] flips bytes in
+                # chip k's drained output — the mercurial-core SDC model.
+                # It corrupts BEFORE the verify pass so the defense is
+                # exercised end to end (and, with integrity off, so an
+                # A/B can demonstrate corrupted bytes reaching clients).
+                try:
+                    failpoints.hit("device.corrupt",
+                                   key=cidx if cidx is not None else 0)
+                except failpoints.FailpointError:
+                    from imaginary_tpu.engine import integrity as integrity_mod
+
+                    outs = [integrity_mod.corrupt_copy(o) for o in outs]
+                reserved = self._verify_chunk(sub, outs, cidx)
+                for i, (it, out) in enumerate(zip(sub, outs)):
+                    if i in reserved:
+                        # transparently re-served from the verified HOST
+                        # copy: the response header must say so (same
+                        # flag the hedge winner uses)
+                        it.future._hedge_placement = "host"
                     if not it.future.done():  # watchdog may have failed it
                         it.future.set_result(out)
             with self._inflight_lock:

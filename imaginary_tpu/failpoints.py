@@ -40,6 +40,21 @@ Sites (each named for the subsystem boundary it sits on):
                    injected error reads as RESOURCE_EXHAUSTED and takes
                    the bisect-retry -> host-routing recovery path, never
                    the breaker
+  device.corrupt   one chunk's DRAINED OUTPUT on one device
+                   (engine/executor.py fetch loop + the golden probe);
+                   keyable by device index — an armed error() makes the
+                   executor flip the high bit of a quarter of the
+                   output's bytes, the mercurial-core SDC model: with
+                   --integrity on, sampled cross-verification must catch
+                   it, re-serve from the verified copy, and corruption-
+                   strike the chip (`device.corrupt[0]=error` is the
+                   SDC-storm chaos row)
+  device.slow      one device's chunk launches and golden probes
+                   (engine/executor.py); keyable by device index — arm
+                   with delay() to make chip k limp without erroring
+                   (`device.slow[0]=delay(250ms)`), the fail-slow shape
+                   the latency demotion exists for; an error() action is
+                   treated as a launch failure
   codec.bomb       the pre-decode bomb gate (codecs/__init__.py): an
                    injected error rejects the decode 413 exactly as a
                    header-dimension bomb would
@@ -88,6 +103,8 @@ SITES = (
     "cache.get",
     "memory.rss",
     "device.oom",
+    "device.corrupt",
+    "device.slow",
     "codec.bomb",
 )
 
@@ -235,7 +252,11 @@ def snapshot() -> dict:
         for site, c in _counts.items():
             sites.setdefault(site, {"action": "(spent)", "hits": c[0],
                                     "fired": c[1]})
-    return {"enabled": bool(_active), "spec": active_spec(), "sites": sites}
+    return {"enabled": bool(_active), "spec": active_spec(), "sites": sites,
+            # the armable registry, so GET /debugz/failpoints doubles as
+            # the help text for what PUT will accept (keyable sites take
+            # the site[key] spelling; see the module docstring per site)
+            "known_sites": list(SITES)}
 
 
 def _decide(site: str, key=None) -> Optional[_Spec]:

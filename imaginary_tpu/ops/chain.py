@@ -383,3 +383,27 @@ def is_oom_error(e: BaseException) -> bool:
 def run_single(arr: np.ndarray, plan: ImagePlan) -> np.ndarray:
     """Single-image convenience wrapper (tests, sync path)."""
     return run_batch([arr], [plan])[0]
+
+
+def output_checksum(out) -> int:
+    """Order-sensitive CRC32 over a staged output's bytes (an ndarray or
+    YuvPlanes), for the output-integrity layer: two devices running the
+    SAME compiled program on the same input are expected bit-identical,
+    so chip-vs-chip cross-verification and the golden-probe telemetry
+    compare these. Host-vs-device comparisons must NOT use it — the host
+    interpreter is PSNR-equivalent, not bit-identical (see
+    engine/integrity.outputs_match's tolerance path). CRC32, not a
+    cryptographic hash: the adversary is a flaky multiplier, not an
+    attacker, and this runs per sampled production batch."""
+    import zlib
+
+    if out is None:
+        return 0
+    if isinstance(out, np.ndarray):
+        return zlib.crc32(np.ascontiguousarray(out).tobytes())
+    planes = [getattr(out, k, None) for k in ("y", "u", "v")]
+    crc = 0
+    for p in planes:
+        if p is not None:
+            crc = zlib.crc32(np.ascontiguousarray(p).tobytes(), crc)
+    return crc

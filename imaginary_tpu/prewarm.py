@@ -40,6 +40,43 @@ def enable_persistent_cache(path: str = "") -> str:
     return path
 
 
+# Golden-probe canary (engine/integrity.py): a fixed synthetic input and
+# a REAL resize op-chain — the same separable-resample program production
+# requests compile — whose reference output is computed once, on the host
+# interpreter, at first use. The old re-admission probe (device_put+add)
+# exercised the transfer path only; a chip corrupting its conv/resize
+# units passed it while serving garbage. Dims are deliberately small
+# (96x128 -> 48x36): the probe runs on quarantined chips at cooldown
+# cadence and must stay cheap.
+_GOLDEN_H, _GOLDEN_W = 96, 128
+_GOLDEN_OUT_W, _GOLDEN_OUT_H = 48, 36
+
+
+def golden_input() -> np.ndarray:
+    """Deterministic SMOOTH gradient (no content discontinuities: the
+    host and device resamplers diverge most at hard edges, and the
+    golden comparison's tolerance must stay far above honest kernel
+    rounding and far below any corrupted byte)."""
+    yy, xx = np.mgrid[0:_GOLDEN_H, 0:_GOLDEN_W]
+    r = (xx * 255) // max(1, _GOLDEN_W - 1)
+    g = (yy * 255) // max(1, _GOLDEN_H - 1)
+    b = ((xx + yy) * 255) // max(1, _GOLDEN_H + _GOLDEN_W - 2)
+    return np.stack([r, g, b], axis=-1).astype(np.uint8)
+
+
+def golden_case() -> tuple:
+    """(input, plan, host_reference): the canary computed once at boot
+    on the HOST (engine/integrity.golden caches it). The host output is
+    ground truth — it never transits the hardware under suspicion."""
+    from imaginary_tpu.engine import host_exec
+
+    arr = golden_input()
+    plan = plan_operation(
+        "resize", ImageOptions(width=_GOLDEN_OUT_W, height=_GOLDEN_OUT_H),
+        _GOLDEN_H, _GOLDEN_W, 0, 3)
+    return arr, plan, host_exec.run(arr, plan)
+
+
 # (operation, options, source dims) matrix covering the hot routes at the
 # common source sizes; extend as real traffic data accumulates.
 _COMMON = [

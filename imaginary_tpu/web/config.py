@@ -89,6 +89,39 @@ class ServerOptions:
     pressure_batch_mb: float = 32.0
     pressure_oversize_mpix: float = 4.0
     pressure_pixel_frac: float = 0.25
+    # --- output-integrity defense (imaginary_tpu/engine/integrity.py) --------
+    # Master switch for SDC defense: golden-probe canaries (devhealth
+    # re-admission probes run a real op-chain and compare against a
+    # boot-time host reference), sampled cross-verification of production
+    # device chunks (mismatch = corruption strike + transparent re-serve
+    # from the verified copy), and poison-batch isolation (deterministic
+    # non-OOM chunk failures bisect to convict the input into a
+    # digest-keyed quarantine list). False = the whole subsystem OFF
+    # (parity: no state object exists, no digest/sample/golden run ever
+    # happens, responses byte-identical to the pre-integrity build).
+    integrity: bool = False
+    # Fraction of production device chunks recomputed + compared before
+    # release (1/256 default; 1.0 verifies everything).
+    integrity_sample: float = 1.0 / 256.0
+    # Consecutive clean golden probes a corruption-struck device needs
+    # before re-admission (crash strikes need one).
+    integrity_clean_probes: int = 3
+    # Poison quarantine list: entry TTL in seconds and size cap.
+    integrity_poison_ttl: float = 300.0
+    integrity_poison_cap: int = 256
+    # --- fail-slow demotion (imaginary_tpu/engine/devhealth.py) --------------
+    # Demote a device whose per-chunk latency EWMA exceeds this ratio x
+    # the median of its PEERS' EWMAs to a `degraded` state that sheds its
+    # dispatch share to healthy chips (readmission through the golden
+    # probe; quarantine if it keeps slipping). 0 = off (parity: the EWMA
+    # is recorded but never consulted — the pre-failslow behavior).
+    failslow_ratio: float = 0.0
+    # Latency samples a device (and each peer) needs before the
+    # comparison may demote it — the cold-fleet hysteresis.
+    failslow_min_samples: int = 8
+    # Fraction of its dispatch rotation a degraded device keeps (0 =
+    # full shed; recovery then rides the golden probe's timed runs).
+    failslow_share: float = 0.0
     # --- multi-tenant QoS (imaginary_tpu/qos/) -------------------------------
     # Tenant table + scheduler/shed knobs: inline JSON (starts with '{')
     # or a file path; parsed once at assembly (qos/tenancy.load_policy).

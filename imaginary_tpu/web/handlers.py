@@ -112,6 +112,17 @@ class ImageService:
             # disables the source cache), not by per-request polling
             pressure.on_transition(
                 lambda _old, new: self.caches.apply_pressure(new))
+        # output-integrity defense (engine/integrity.py): built here so
+        # /health can read its counters next to the executor's; the
+        # golden host reference is computed NOW, at boot — a reference
+        # computed lazily under suspicion of a sick chip would be
+        # computed too late to be trusted as a boot-time ground truth.
+        # None when --integrity is off: no state, no checks, parity.
+        from imaginary_tpu.engine import integrity as integrity_mod
+
+        self.integrity = integrity_mod.from_options(o)
+        if self.integrity is not None or o.failslow_ratio > 0.0:
+            integrity_mod.golden()
         # donation rides the chain module (the donate flag is part of the
         # compile-cache key, shared with prewarm): set before the executor
         # exists so its first dispatch compiles what serving will use
@@ -135,6 +146,10 @@ class ImageService:
                 hedge_budget=o.hedge_budget,
                 qos=qos,
                 pressure=pressure,
+                integrity=self.integrity,
+                failslow_ratio=o.failslow_ratio,
+                failslow_min_samples=o.failslow_min_samples,
+                failslow_share=o.failslow_share,
             )
         )
         from imaginary_tpu.engine.executor import _available_cpus

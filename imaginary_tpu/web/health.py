@@ -61,6 +61,14 @@ def get_health_stats(executor=None, qos=None, pressure=None) -> dict:
         # same block as imaginary_tpu_device_state so the two surfaces
         # cannot drift.
         stats["deviceHealth"] = executor.devhealth.snapshot()
+        integ = getattr(executor, "integrity", None)
+        if integ is not None:
+            # output-integrity defense (engine/integrity.py): sampled
+            # cross-verification counters + poison quarantine occupancy;
+            # /metrics renders the same block as imaginary_tpu_integrity_*
+            # so the two surfaces cannot drift. Absent with --integrity
+            # off — the block's presence IS the armed/parity signal.
+            stats["integrity"] = integ.snapshot()
     if qos is not None:
         # per-class qos counters + live queue depths (qos/shed.py
         # QosStats); /metrics renders the same block as

@@ -81,6 +81,7 @@ def render_metrics(stats: dict) -> str:
     hedge_outcomes: dict = {}
     device_health: dict = {}
     pressure: dict = {}
+    integrity: dict = {}
     oom_splits = None
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
@@ -103,6 +104,8 @@ def render_metrics(stats: dict) -> str:
             device_health = value
         elif key == "pressure" and isinstance(value, dict):
             pressure = value
+        elif key == "integrity" and isinstance(value, dict):
+            integrity = value
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
@@ -175,14 +178,53 @@ def render_metrics(stats: dict) -> str:
                device_health.get("quarantined", 0),
                help_text="Devices removed from the dispatchable set by "
                          "their per-device breaker.")
+        x.emit("imaginary_tpu_devices_degraded",
+               device_health.get("degraded", 0),
+               help_text="Devices demoted by fail-slow detection (latency "
+                         "EWMA above the fleet-median ratio); dispatch "
+                         "share shed to healthy chips.")
+        x.emit("imaginary_tpu_corruption_strikes_total",
+               device_health.get("corruptions", 0), mtype="counter",
+               help_text="Corruption strikes booked fleet-wide (golden-"
+                         "probe mismatches + failed sampled "
+                         "cross-verifications).")
         for d in device_health.get("per_device", ()):
             x.emit(
                 "imaginary_tpu_device_state", 1,
                 f'device="{d.get("device", "")}",'
                 f'state="{escape_label_value(str(d.get("state", "")))}"',
                 help_text="Per-device fault-domain state "
-                          "(healthy|quarantined|half_open); value is "
-                          "always 1.")
+                          "(healthy|degraded|quarantined|half_open); "
+                          "value is always 1.")
+    if integrity:
+        x.emit("imaginary_tpu_integrity_checks_total",
+               integrity.get("checks", 0), mtype="counter",
+               help_text="Sampled cross-verification comparisons "
+                         "performed before response release.")
+        x.emit("imaginary_tpu_integrity_mismatches_total",
+               integrity.get("mismatches", 0), mtype="counter",
+               help_text="Cross-verification comparisons that failed "
+                         "(silent data corruption caught).")
+        x.emit("imaginary_tpu_integrity_reserved_total",
+               integrity.get("reserved", 0), mtype="counter",
+               help_text="Responses transparently re-served from the "
+                         "verified copy after a mismatch.")
+        x.emit("imaginary_tpu_integrity_skipped_total",
+               integrity.get("skipped", 0), mtype="counter",
+               help_text="Sampled items with no independent recompute "
+                         "path (host-inexecutable plan, no peer chip).")
+        x.emit("imaginary_tpu_integrity_poison_entries",
+               integrity.get("poison_entries", 0),
+               help_text="Inputs currently in the poison quarantine "
+                         "list (TTL + cap bounded).")
+        x.emit("imaginary_tpu_integrity_poison_hits_total",
+               integrity.get("poison_hits", 0), mtype="counter",
+               help_text="Submits short-circuited to host/422 by the "
+                         "poison quarantine list.")
+        x.emit("imaginary_tpu_integrity_poison_isolated_total",
+               integrity.get("poison_isolated", 0), mtype="counter",
+               help_text="Inputs convicted by the bisect of failing "
+                         "device execution in isolation.")
     if oom_splits is not None:
         x.emit("imaginary_tpu_oom_splits_total", oom_splits, mtype="counter",
                help_text="Device-batch bisections performed by the OOM "
