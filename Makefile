@@ -27,8 +27,9 @@ gate: lint test chaos
 	  { echo "bench_device.py policy A/B failed - snapshot NOT green"; exit 1; }
 	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device benches all pass"
 
-# Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10): the deadline/
-# failpoint/devhealth/pressure/integrity suites, then six soaks — a
+# Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10 + ISSUE 11): the
+# deadline/failpoint/devhealth/pressure/integrity/fleet suites, then
+# nine soaks — a
 # flaky-origin row (source.fetch=error(0.2): availability >= 95%, honest
 # 502/503/504 mapping, deadline boundedness, ledgers at rest), a
 # chip-loss row (device.chip_error on the primary device mid-run:
@@ -43,9 +44,16 @@ gate: lint test chaos
 # probe latency comparison and fleet p99 recovers to within 1.5x of the
 # healthy baseline). The two forced CPU devices make the multi-chip
 # fault-domain path run on hardware-less CI; real multi-chip hosts
-# exercise it natively.
+# exercise it natively. Rows 7-9 (ISSUE 11) then boot REAL 2-worker
+# SO_REUSEPORT fleets with the shared cache armed and kill processes:
+# SIGKILL mid-write storm (>=99% availability, zero corrupt-byte
+# serves, the torn slot reclaimed), SIGSTOP-past-liveness zombie (the
+# revived worker is epoch-fenced: reads ok, publishes refused), and a
+# SIGHUP rolling restart under open-loop load (100% availability,
+# per-index epochs monotonic); counters archived to
+# artifacts/chaos_fleet.json.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py -q -m 'not slow'
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py tests/test_fleet.py -q -m 'not slow'
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
 	  JAX_PLATFORMS=cpu python bench_chaos.py || \

@@ -55,10 +55,34 @@ recovers to within 1.5x of the healthy baseline with no availability
 loss. 1-device hosts assert the documented no-op degeneration (no
 peers, no demotion, availability holds).
 
+ROWS 7-9 — fleet tier (ISSUE 11): real 2-worker SO_REUSEPORT fleets
+(subprocesses, each paying a jax boot) with the crash-safe shared cache
+armed, driven over HTTP with the LB retry contract (one fast retry on a
+503 + Retry-After or a connection reset — exactly what a balancer does).
+
+ROW 7 — SIGKILL mid-write storm: hot zipf load over the shared cache,
+one worker SIGKILLed mid-storm. Invariants: >= 99% availability, the
+supervisor respawns the dead worker, `fleet_cache_corrupt_served_total`
+stays 0 on every worker, and a DETERMINISTIC torn-write proof: a writer
+process killed inside the `fleet.write` window (delay failpoint) leaves
+a WRITING slot that readers skip and `sweep()` reclaims.
+
+ROW 8 — SIGSTOP zombie fencing: a worker SIGSTOPped past the (bench-
+shortened) liveness window is replaced at epoch+1; the shm epoch table
+must show the new stamp, and a client wearing the ZOMBIE's identity
+(old epoch) must be able to read but not publish — the revived zombie
+is fenced. SIGCONT then releases it into the supervisor's queued
+SIGTERM/SIGKILL; the process must actually exit.
+
+ROW 9 — SIGHUP rolling restart: open-loop load through a full fleet
+roll. Invariants: 100% ultimate availability (the retry contract may
+be used, zero requests lost), per-index epochs strictly monotonic, and
+both indices finish on fresh epochs.
+
 Prints one JSON line per row on stdout; human detail on stderr; nonzero
 exit on any violated invariant. Integrity/fail-slow counters from rows
-5-6 are archived to artifacts/chaos_integrity.json next to the BENCH
-artifacts.
+5-6 are archived to artifacts/chaos_integrity.json; fleet counters from
+rows 7-9 to artifacts/chaos_fleet.json.
 """
 
 from __future__ import annotations
@@ -67,7 +91,10 @@ import asyncio
 import itertools
 import json
 import os
+import signal
+import subprocess
 import sys
+import tempfile
 import time
 
 import aiohttp
@@ -794,6 +821,538 @@ def _failslow_row(duration: float, concurrency: int) -> tuple:
     return 0, row
 
 
+# --- fleet rows (ISSUE 11): real SO_REUSEPORT fleets, process signals --------
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+class _Fleet:
+    """A real 2-worker supervisor fleet + an in-bench origin server."""
+
+    def __init__(self, extra_env=None, extra_args=()):
+        self.extra_env = extra_env or {}
+        self.extra_args = list(extra_args)
+        self.sup = None
+        self.port = None
+        self.fleet_path = None
+        self.origin_runner = None
+        self.origin_base = None
+
+    async def start(self):
+        from bench_cache import N_URLS, _start_origin
+        from bench_util import free_port, make_1080p_jpeg
+
+        base_jpeg = make_1080p_jpeg()
+        variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+        self.origin_runner, self.origin_base = await _start_origin(variants)
+        self.port = free_port()
+        fd, self.fleet_path = tempfile.mkstemp(prefix="chaos-fleet-",
+                                               suffix=".shm")
+        os.close(fd)
+        os.unlink(self.fleet_path)  # the supervisor creates it fresh
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for k in ("IMAGINARY_TPU_WORKER", "IMAGINARY_TPU_WORKER_EPOCH",
+                  "IMAGINARY_TPU_FAILPOINTS"):
+            env.pop(k, None)
+        env["IMAGINARY_TPU_FLEET_PATH"] = self.fleet_path
+        env.update(self.extra_env)
+        self.sup = subprocess.Popen(
+            [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
+             "--port", str(self.port), "--enable-url-source",
+             "--cache-result-mb", "16", "--fleet-cache-mb", "16",
+             "--request-timeout", "10"] + self.extra_args,
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    async def health(self, session, timeout=2.0):
+        # Connection: close — every sample opens a FRESH connection so
+        # the kernel's SO_REUSEPORT spread reaches every worker; a
+        # pooled keep-alive connection would pin sampling to one pid
+        async with session.get(
+                f"http://127.0.0.1:{self.port}/health",
+                headers={"Connection": "close"},
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            return await r.json()
+
+    async def wait_workers(self, session, n=2, deadline_s=120.0) -> dict:
+        """Sample /health until n distinct worker indices answer;
+        returns {idx: {"pid":…, "epoch":…}}."""
+        seen: dict = {}
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            if self.sup.poll() is not None:
+                raise RuntimeError(
+                    f"fleet supervisor exited {self.sup.poll()} during boot")
+            try:
+                h = await self.health(session)
+                seen[h["worker"]] = {"pid": h["pid"], "epoch": h["epoch"]}
+                if len(seen) >= n:
+                    return seen
+            except Exception:
+                pass
+            await asyncio.sleep(0.2)
+        raise RuntimeError(f"fleet never reached {n} workers (saw {seen})")
+
+    def url(self, i: int) -> str:
+        return (f"http://127.0.0.1:{self.port}/resize?width=300&height=200"
+                f"&url={self.origin_base}/img/{i}")
+
+    async def stop(self):
+        if self.sup is not None and self.sup.poll() is None:
+            self.sup.send_signal(signal.SIGTERM)
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.sup.wait, 20)
+            except subprocess.TimeoutExpired:
+                self.sup.kill()
+                self.sup.wait()
+        if self.origin_runner is not None:
+            await self.origin_runner.cleanup()
+        if self.fleet_path and os.path.exists(self.fleet_path):
+            try:
+                os.unlink(self.fleet_path)
+            except OSError:
+                pass
+
+
+async def _lb_get(session, url: str, counts: dict, retries: int = 2,
+                  timeout_s: float = 8.0) -> bool:
+    """One request under the LB retry contract: a 503 + Retry-After or a
+    connection error is retried (fast) up to `retries` times — that IS
+    the documented drain/shed semantics; what must never happen is an
+    ULTIMATE failure. Returns whether the request ultimately succeeded."""
+    for attempt in range(retries + 1):
+        try:
+            # Connection: close = the LB model: every attempt (and every
+            # retry in particular) rides a fresh connection the kernel
+            # may route to a DIFFERENT worker — a keep-alive retry would
+            # re-ask the very worker that just shed us
+            async with session.get(
+                    url, headers={"Connection": "close"},
+                    timeout=aiohttp.ClientTimeout(total=timeout_s)) as r:
+                body = await r.read()
+                counts[r.status] = counts.get(r.status, 0) + 1
+                if r.status == 200 and body:
+                    return True
+                if r.status not in (502, 503, 504):
+                    return False
+        except Exception:
+            counts["exc"] = counts.get("exc", 0) + 1
+        if attempt < retries:
+            counts["retries"] = counts.get("retries", 0) + 1
+            await asyncio.sleep(0.2)
+    return False
+
+
+async def _fleet_counters(fleet, session, seconds: float = 4.0) -> dict:
+    """Sample /health across the fleet and keep each pid's LATEST fleet
+    block (counters only ever grow; per-pid last-write-wins)."""
+    per_pid: dict = {}
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        try:
+            h = await fleet.health(session)
+            if "fleet" in h:
+                per_pid[h["pid"]] = dict(h["fleet"], worker=h["worker"],
+                                         epoch=h["epoch"])
+        except Exception:
+            pass
+        await asyncio.sleep(0.1)
+    return per_pid
+
+
+def _spawn_torn_writer(fleet_path: str) -> subprocess.Popen:
+    """A writer that starts a deposit and stalls inside the WRITING
+    window (fleet.write delay failpoint) so a SIGKILL leaves a real
+    torn slot. Uses a high worker index no serving worker occupies."""
+    code = (
+        "import hashlib\n"
+        "from imaginary_tpu import failpoints\n"
+        "from imaginary_tpu.fleet.shmcache import ShmCache\n"
+        "failpoints.activate('fleet.write=delay(60s)')\n"
+        f"w = ShmCache({fleet_path!r}, create=False, worker=60, epoch=0)\n"
+        "print('mid-write', flush=True)\n"
+        "w.put(hashlib.sha256(b'chaos-torn').digest(), b'm', b'x' * 2000)\n"
+    )
+    return subprocess.Popen([sys.executable, "-c", code], cwd=ROOT,
+                            stdout=subprocess.PIPE)
+
+
+async def _fleet_kill_soak(duration: float, concurrency: int) -> dict:
+    from bench_cache import N_URLS, ZIPF_S, _zipf_indices
+    from imaginary_tpu.fleet.shmcache import FREE, WRITING, ShmCache
+
+    fleet = _Fleet()
+    counts: dict = {}
+    outcomes = {"ok": 0, "fail": 0}
+    try:
+        await fleet.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            workers0 = await fleet.wait_workers(session)
+            seq = _zipf_indices(50_000, N_URLS, ZIPF_S)
+            urls = itertools.cycle([fleet.url(i) for i in seq])
+            victim = {"pid": None}
+
+            async def drive(seconds: float) -> None:
+                deadline = time.monotonic() + seconds
+
+                async def worker():
+                    while time.monotonic() < deadline:
+                        ok = await _lb_get(session, next(urls), counts)
+                        outcomes["ok" if ok else "fail"] += 1
+
+                await asyncio.gather(*[worker() for _ in range(concurrency)])
+
+            await drive(max(duration / 3, 2.0))  # warm: caches fill
+
+            async def kill_mid_storm():
+                await asyncio.sleep(max(duration / 6, 0.7))
+                victim["pid"] = workers0[1]["pid"]
+                os.kill(victim["pid"], signal.SIGKILL)
+                print(f"[chaos] fleet-kill: SIGKILLed worker pid "
+                      f"{victim['pid']} mid-storm", file=sys.stderr)
+
+            await asyncio.gather(drive(max(duration, 4.0)), kill_mid_storm())
+            # the supervisor must respawn index 1 (fresh pid, fresh epoch)
+            respawned = False
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                try:
+                    h = await fleet.health(session)
+                    if h["worker"] == 1 and h["pid"] != victim["pid"]:
+                        respawned = True
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            per_pid = await _fleet_counters(fleet, session)
+            # deterministic torn-write proof against the LIVE fleet file
+            torn = {"left_writing": False, "reclaimed": 0, "final_free": False}
+            p = _spawn_torn_writer(fleet.fleet_path)
+            try:
+                assert b"mid-write" in p.stdout.readline()
+                await asyncio.sleep(0.8)
+                p.kill()
+                p.wait()
+                import hashlib
+
+                k = hashlib.sha256(b"chaos-torn").digest()
+                client = ShmCache(fleet.fleet_path, create=False, worker=61,
+                                  epoch=0)
+                try:
+                    idx = client._candidates(k)[0]
+                    torn["left_writing"] = client._slot_state(idx) == WRITING
+                    assert client.get(k) is None  # skipped, never served
+                    torn["reclaimed"] = client.sweep()
+                    torn["final_free"] = client._slot_state(idx) == FREE
+                finally:
+                    client.close()
+            finally:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+    finally:
+        await fleet.stop()
+    return {"counts": counts, "outcomes": outcomes, "respawned": respawned,
+            "per_pid": per_pid, "torn": torn}
+
+
+def _fleet_kill_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_fleet_kill_soak(duration, concurrency))
+    o = got["outcomes"]
+    total = o["ok"] + o["fail"]
+    corrupt_served = sum(v.get("corrupt_served", 0)
+                         for v in got["per_pid"].values())
+    corrupt = sum(v.get("corrupt", 0) for v in got["per_pid"].values())
+    row = {
+        "metric": "chaos_fleet_kill_storm",
+        "requests": total,
+        "ok": o["ok"],
+        "ok_ratio": round(o["ok"] / total, 4) if total else 0.0,
+        "retries": got["counts"].get("retries", 0),
+        "respawned": got["respawned"],
+        "corrupt_served_total": corrupt_served,
+        "corrupt_total": corrupt,
+        "torn": got["torn"],
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if total == 0:
+        fails.append("fleet kill storm produced zero requests")
+    if total and o["ok"] / total < 0.99:
+        fails.append(f"availability {o['ok']}/{total} below 99% under "
+                     "worker SIGKILL")
+    if not got["respawned"]:
+        fails.append("killed worker never respawned")
+    if corrupt_served:
+        fails.append(f"{corrupt_served} corrupt-byte serves (tripwire)")
+    if not got["torn"]["left_writing"]:
+        fails.append("SIGKILLed writer did not leave a WRITING slot "
+                     "(torn-write window never exercised)")
+    if got["torn"]["reclaimed"] != 1 or not got["torn"]["final_free"]:
+        fails.append(f"torn slot not reclaimed by sweep: {got['torn']}")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (fleet SIGKILL storm): {o['ok']}/{total} ok "
+          f"({got['counts'].get('retries', 0)} LB retries), worker "
+          "respawned, 0 corrupt serves, torn slot swept", file=sys.stderr)
+    return 0, row
+
+
+async def _fleet_zombie_soak(duration: float, concurrency: int) -> dict:
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    fleet = _Fleet(extra_env={
+        "IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL": "0.3",
+        "IMAGINARY_TPU_SUPERVISOR_PROBE_TIMEOUT": "1.0",
+        "IMAGINARY_TPU_SUPERVISOR_LIVENESS_TIMEOUT": "4.0",
+        "IMAGINARY_TPU_SUPERVISOR_HANG_GRACE": "2.0",
+        # boot on this host is seconds; the default 90 s grace would
+        # stall hang detection for a worker the probe had not yet
+        # sighted when the SIGSTOP landed
+        "IMAGINARY_TPU_SUPERVISOR_BOOT_GRACE": "20.0",
+    })
+    counts: dict = {}
+    out = {"replaced": False, "zombie_exited": False, "fence": {},
+           "ok": 0, "fail": 0}
+    try:
+        await fleet.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            workers0 = await fleet.wait_workers(session)
+            # let the SUPERVISOR's own probe sight both workers before
+            # the stop: its liveness clock runs from last sighting
+            await asyncio.sleep(3.0)
+            zpid, zepoch = workers0[1]["pid"], workers0[1]["epoch"]
+            print(f"[chaos] zombie: SIGSTOP worker 1 (pid {zpid}, "
+                  f"epoch {zepoch})", file=sys.stderr)
+            os.kill(zpid, signal.SIGSTOP)
+            # the liveness probe must declare it hung and replace it at a
+            # fresh epoch (stamped BEFORE the replacement spawns)
+            end = time.monotonic() + 90.0
+            new_epoch = None
+            while time.monotonic() < end:
+                try:
+                    h = await fleet.health(session, timeout=1.5)
+                    if h["worker"] == 1 and h["pid"] != zpid \
+                            and h["epoch"] > zepoch:
+                        new_epoch = h["epoch"]
+                        out["replaced"] = True
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            # the fence, asserted against the LIVE fleet file: a client
+            # wearing the zombie's identity may read but not publish
+            client = ShmCache(fleet.fleet_path, create=False, worker=1,
+                              epoch=zepoch)
+            try:
+                stamped = client.epoch_of(1)
+                fenced = client.fenced()
+                publish_refused = not client.put(b"f" * 32, b"m", b"b")
+                read_ok = client.get(b"f" * 32) is None  # miss, not error
+                out["fence"] = {
+                    "stamped_epoch": stamped, "old_epoch": zepoch,
+                    "new_epoch": new_epoch, "fenced": fenced,
+                    "publish_refused": publish_refused,
+                    "fenced_publishes": client.stats.fenced_publishes,
+                    "read_ok": read_ok,
+                }
+            finally:
+                client.close()
+            # wake the zombie into the supervisor's queued SIGTERM; it
+            # must actually exit (SIGKILL escalation past the grace)
+            os.kill(zpid, signal.SIGCONT)
+            end = time.monotonic() + 30.0
+            while time.monotonic() < end:
+                try:
+                    os.kill(zpid, 0)
+                except ProcessLookupError:
+                    out["zombie_exited"] = True
+                    break
+                await asyncio.sleep(0.2)
+            # the fleet serves normally again
+            for _ in range(20):
+                ok = await _lb_get(session, fleet.url(0), counts)
+                out["ok" if ok else "fail"] += 1
+    finally:
+        await fleet.stop()
+    out["counts"] = counts
+    return out
+
+
+def _fleet_zombie_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_fleet_zombie_soak(duration, concurrency))
+    f = got["fence"]
+    row = {
+        "metric": "chaos_fleet_zombie_fence",
+        "replaced": got["replaced"],
+        "zombie_exited": got["zombie_exited"],
+        "fence": f,
+        "post_recovery_ok": got["ok"],
+        "post_recovery_fail": got["fail"],
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if not got["replaced"]:
+        fails.append("SIGSTOPped worker was never replaced by the "
+                     "liveness probe")
+    if not f.get("fenced"):
+        fails.append(f"zombie epoch not fenced (table {f})")
+    if not f.get("publish_refused") or f.get("fenced_publishes") != 1:
+        fails.append("zombie publish was NOT refused — post-fence "
+                     "publishes possible")
+    if not f.get("read_ok"):
+        fails.append("fenced zombie lost READ access (only publishes "
+                     "must be refused)")
+    if not got["zombie_exited"]:
+        fails.append("revived zombie never exited (SIGTERM/SIGKILL "
+                     "escalation failed)")
+    if got["fail"]:
+        fails.append(f"{got['fail']} post-recovery requests failed")
+    if fails:
+        for fl in fails:
+            print(f"[chaos] FAIL: {fl}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (fleet zombie): replaced at epoch "
+          f"{f['new_epoch']} (old {f['old_epoch']}), zombie fenced "
+          "(reads ok, publish refused), zombie reaped, "
+          f"{got['ok']}/20 post-recovery ok", file=sys.stderr)
+    return 0, row
+
+
+async def _fleet_roll_soak(duration: float, concurrency: int) -> dict:
+    fleet = _Fleet(extra_args=["--fleet-roll-grace", "1.5"])
+    counts: dict = {}
+    out = {"ok": 0, "fail": 0, "rolled": False}
+    epochs_seen: dict = {0: [], 1: []}
+    try:
+        await fleet.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            workers0 = await fleet.wait_workers(session)
+            before = {i: w["epoch"] for i, w in workers0.items()}
+            stop_flag = {"stop": False}
+
+            async def open_loop_load():
+                # open-loop: a new request every tick regardless of
+                # completions (rate ~ 5 x concurrency req/s)
+                pending = set()
+                i = 0
+                while not stop_flag["stop"]:
+                    i += 1
+
+                    async def one(u=fleet.url(i % 16)):
+                        ok = await _lb_get(session, u, counts, retries=3)
+                        out["ok" if ok else "fail"] += 1
+
+                    pending.add(asyncio.ensure_future(one()))
+                    pending = {t for t in pending if not t.done()}
+                    await asyncio.sleep(max(0.01, 0.2 / concurrency))
+                if pending:
+                    await asyncio.gather(*pending, return_exceptions=True)
+
+            async def sample_epochs():
+                while not stop_flag["stop"]:
+                    try:
+                        h = await fleet.health(session, timeout=1.5)
+                        epochs_seen[h["worker"]].append(h["epoch"])
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.1)
+
+            load = asyncio.ensure_future(open_loop_load())
+            sampler = asyncio.ensure_future(sample_epochs())
+            await asyncio.sleep(1.0)
+            print("[chaos] roll: SIGHUP to the supervisor", file=sys.stderr)
+            fleet.sup.send_signal(signal.SIGHUP)
+            end = time.monotonic() + 240.0
+            while time.monotonic() < end:
+                cur = {i: max(v) if v else before[i]
+                       for i, v in epochs_seen.items()}
+                if cur[0] > before[0] and cur[1] > before[1]:
+                    out["rolled"] = True
+                    break
+                await asyncio.sleep(0.3)
+            # settle: the last old worker finishes its grace + drain and
+            # exits, so the tail samples prove the steady state is
+            # new-epochs-only (its listener closed at SIGUSR1, so no new
+            # connection can reach an old epoch from here anyway)
+            await asyncio.sleep(12.0)
+            stop_flag["stop"] = True
+            await asyncio.gather(load, sampler, return_exceptions=True)
+            out["before"] = before
+            out["after"] = {i: max(v) if v else 0
+                            for i, v in epochs_seen.items()}
+    finally:
+        await fleet.stop()
+    out["epochs_seen"] = epochs_seen
+    out["counts"] = counts
+    return out
+
+
+def _fleet_roll_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_fleet_roll_soak(duration, concurrency))
+    total = got["ok"] + got["fail"]
+    # Epoch monotonicity under a roll: during each handover BOTH the old
+    # and new holder of an index serve (that is the zero-downtime
+    # design), so raw samples interleave the two. The invariants: no
+    # index ever shows an epoch OUTSIDE {its old, its new} (nothing
+    # regressed, nothing minted off the books), every new epoch is
+    # strictly greater, and the steady state after the roll is
+    # new-epochs-only (the deposed listeners are gone).
+    before, after = got.get("before", {}), got.get("after", {})
+    monotonic = True
+    for idx, seq in got["epochs_seen"].items():
+        allowed = {before.get(idx), after.get(idx)}
+        if not seq or not set(seq) <= allowed \
+                or after.get(idx, 0) <= before.get(idx, 0) \
+                or seq[-3:] != [after.get(idx)] * len(seq[-3:]):
+            monotonic = False
+    row = {
+        "metric": "chaos_fleet_sighup_roll",
+        "requests": total,
+        "ok": got["ok"],
+        "ok_ratio": round(got["ok"] / total, 4) if total else 0.0,
+        "retries": got["counts"].get("retries", 0),
+        "rolled": got["rolled"],
+        "epochs_before": got.get("before", {}),
+        "epochs_after": got.get("after", {}),
+        "epochs_monotonic": monotonic,
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if total == 0:
+        fails.append("roll soak produced zero requests")
+    if not got["rolled"]:
+        fails.append("SIGHUP roll never completed (epochs did not "
+                     "advance on both indices)")
+    if got["fail"]:
+        fails.append(f"{got['fail']}/{total} requests ultimately failed "
+                     "during the roll (must be 100% available)")
+    if not monotonic:
+        fails.append(f"per-index epochs regressed: {got['epochs_seen']}")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (SIGHUP roll): {got['ok']}/{total} ok at 100% "
+          f"({got['counts'].get('retries', 0)} LB retries), epochs "
+          f"{got.get('before')} -> {got.get('after')}, monotonic",
+          file=sys.stderr)
+    return 0, row
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -878,7 +1437,27 @@ def main() -> int:
     except OSError as e:
         print(f"[chaos] WARN: could not archive integrity counters: {e}",
               file=sys.stderr)
-    return rc_sdc or rc_fs
+    if rc_sdc or rc_fs:
+        return rc_sdc or rc_fs
+    # ROWS 7-9 (ISSUE 11): the fleet tier — real 2-worker subprocess
+    # fleets under process-kill chaos; counters archived per row
+    rc_kill, kill_row = _fleet_kill_row(duration, concurrency)
+    if rc_kill:
+        return rc_kill
+    rc_zombie, zombie_row = _fleet_zombie_row(duration, concurrency)
+    if rc_zombie:
+        return rc_zombie
+    rc_roll, roll_row = _fleet_roll_row(duration, concurrency)
+    try:
+        with open("artifacts/chaos_fleet.json", "w") as f:
+            json.dump({"kill_storm": kill_row, "zombie_fence": zombie_row,
+                       "sighup_roll": roll_row}, f, indent=2, sort_keys=True)
+        print("[chaos] fleet counters archived to "
+              "artifacts/chaos_fleet.json", file=sys.stderr)
+    except OSError as e:
+        print(f"[chaos] WARN: could not archive fleet counters: {e}",
+              file=sys.stderr)
+    return rc_roll
 
 
 if __name__ == "__main__":

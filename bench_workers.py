@@ -11,6 +11,13 @@ binding resource — the point of the artifact is the mechanism's cost,
 not a speedup this host cannot produce); on an M-core host the VERDICT
 acceptance is >=1.7x at N=2. One JSON line per worker count.
 
+A second row (ISSUE 11) A/Bs the fleet shared cache: N workers on a
+zipf hot-URL workload with N INDEPENDENT result caches vs the same
+caches tiered over the crash-safe shm cache — cross-worker hits mean a
+result any worker computed serves the whole fleet, so the shm arm must
+beat (or at minimum match) the independent arm, with the cross-worker
+hit ratio reported. BENCH_SHM_AB=0 skips it.
+
 Usage: python bench_workers.py            # N in {1, 2}
        BENCH_WORKERS="1 2 4" BENCH_DURATION=15 python bench_workers.py
 """
@@ -22,6 +29,8 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 import urllib.request
 
@@ -83,6 +92,199 @@ def bench_n(n: int, body: bytes, duration: float, n_threads: int) -> dict:
             sup.wait()
 
 
+# --- fleet shared-cache A/B (ISSUE 11) ---------------------------------------
+
+# zipf-ish hot-URL workload: enough distinct URLs (and a flat-enough
+# tail) that miss traffic dominates the measured window — per-worker
+# INDEPENDENT caches pay every URL's compute once per worker, while the
+# shm tier pays it once per FLEET. The arms measure from COLD result
+# caches (the warmup touches one dedicated URL, enough to absorb
+# compile/boot costs): the difference between the arms IS the miss
+# traffic, so a pre-warmed measurement window would show nothing. Run
+# ABBA (off-on-on-off) so slow host drift cancels out of the ratio.
+SHM_AB_URLS = 192
+SHM_AB_ZIPF = 0.7
+
+
+def _zipf_seq(n: int, n_urls: int, s: float) -> list:
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    weights = 1.0 / np.arange(1, n_urls + 1) ** s
+    weights /= weights.sum()
+    return list(rng.choice(n_urls, size=n, p=weights))
+
+
+def _start_origin(variants: list):
+    """Stdlib threading origin serving /img/{i} (the fleet workers are
+    subprocesses, so the origin must be a real listener, but it needs no
+    asyncio — bench_workers is a sync harness)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            try:
+                i = int(self.path.rsplit("/", 1)[-1]) % len(variants)
+            except ValueError:
+                self.send_error(404)
+                return
+            body = variants[i]
+            self.send_response(200)
+            self.send_header("Content-Type", "image/jpeg")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def _sum_fleet_counters(port: int, samples: int = 30) -> dict:
+    """Sum the per-worker fleet blocks (sample /health until both pids
+    seen; counters only grow, keep each pid's latest)."""
+    per_pid: dict = {}
+    for _ in range(samples):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2) as r:
+                h = json.loads(r.read())
+            if "fleet" in h:
+                per_pid[h["pid"]] = h["fleet"]
+        except Exception:
+            time.sleep(0.1)
+    out = {"workers_seen": len(per_pid)}
+    for k in ("hits", "misses", "publishes", "corrupt", "corrupt_served"):
+        out[k] = sum(v.get(k, 0) for v in per_pid.values())
+    return out
+
+
+def _shm_arm(n: int, origin_base: str, seq: list, duration: float,
+             n_threads: int, shm_on: bool) -> dict:
+    port = free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", env.get("BENCH_PLATFORM", "cpu"))
+    for k in ("IMAGINARY_TPU_WORKER", "IMAGINARY_TPU_WORKER_EPOCH"):
+        env.pop(k, None)
+    fleet_path = None
+    args = [sys.executable, "-m", "imaginary_tpu.cli", "--workers", str(n),
+            "--port", str(port), "--enable-url-source",
+            "--cache-result-mb", "32"]
+    if shm_on:
+        fd, fleet_path = tempfile.mkstemp(prefix="bench-fleet-",
+                                          suffix=".shm")
+        os.close(fd)
+        os.unlink(fleet_path)
+        env["IMAGINARY_TPU_FLEET_PATH"] = fleet_path
+        args += ["--fleet-cache-mb", "64"]
+    else:
+        env.pop("IMAGINARY_TPU_FLEET_PATH", None)
+    sup = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    try:
+        _wait_healthy(port)
+        urls = [f"http://127.0.0.1:{port}/resize?width=300&height=200"
+                f"&url={origin_base}/img/{i}" for i in seq]
+        # warm ONLY the boot/compile path (one dedicated URL outside the
+        # measured set): the measured window starts with cold result
+        # caches in both arms, so the miss traffic — where the shm tier
+        # earns its keep — is what gets measured
+        warm_url = (f"http://127.0.0.1:{port}/resize?width=300&height=200"
+                    f"&url={origin_base}/img/{SHM_AB_URLS}")
+
+        def one(k, i, _urls=urls):
+            req = urllib.request.Request(_urls[i % len(_urls)],
+                                         headers={"Connection": "close"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+                assert r.status == 200
+
+        def warm(k, i):
+            one(k, 0, _urls=[warm_url])
+
+        run_workers(warm, max(4.0, duration / 3), n_threads)
+        rate, lats = run_workers(one, duration, n_threads)
+        counters = _sum_fleet_counters(port) if shm_on else {}
+        return {"rate": rate, "p50_ms": pctl(lats, 0.50),
+                "p99_ms": pctl(lats, 0.99), "fleet": counters}
+    finally:
+        sup.send_signal(signal.SIGTERM)
+        try:
+            sup.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+            sup.wait()
+        if fleet_path and os.path.exists(fleet_path):
+            try:
+                os.unlink(fleet_path)
+            except OSError:
+                pass
+
+
+def shm_ab(duration: float, n_threads: int, n: int = 2) -> int:
+    base = make_1080p_jpeg()
+    # +1: the last variant is the warmup-only URL (boot/compile), never
+    # part of the measured zipf set
+    variants = [base + b"\x00" * (i + 1) for i in range(SHM_AB_URLS + 1)]
+    origin, origin_base = _start_origin(variants)
+    try:
+        seq = _zipf_seq(20_000, SHM_AB_URLS, SHM_AB_ZIPF)
+        arms = []
+        for shm_on in (False, True, True, False):  # ABBA: drift cancels
+            arms.append(_shm_arm(n, origin_base, seq, duration, n_threads,
+                                 shm_on=shm_on))
+    finally:
+        origin.shutdown()
+    off_rate = (arms[0]["rate"] + arms[3]["rate"]) / 2.0
+    on_rate = (arms[1]["rate"] + arms[2]["rate"]) / 2.0
+    off = {"rate": off_rate,
+           "p99_ms": max(arms[0]["p99_ms"], arms[3]["p99_ms"])}
+    on = {"rate": on_rate, "p99_ms": max(arms[1]["p99_ms"],
+                                         arms[2]["p99_ms"])}
+    fleet = {k: arms[1]["fleet"].get(k, 0) + arms[2]["fleet"].get(k, 0)
+             for k in ("hits", "misses", "publishes", "corrupt",
+                       "corrupt_served")}
+    lookups = fleet.get("hits", 0) + fleet.get("misses", 0)
+    cross_ratio = round(fleet.get("hits", 0) / lookups, 4) if lookups else 0.0
+    ratio = round(on["rate"] / off["rate"], 3) if off["rate"] else 0.0
+    row = {
+        "metric": "workers_shm_cache_ab",
+        "workers": n,
+        "unit": "req/sec",
+        "independent_caches": round(off["rate"], 2),
+        "shm_tier": round(on["rate"], 2),
+        "ratio": ratio,
+        "p99_ms_independent": off["p99_ms"],
+        "p99_ms_shm": on["p99_ms"],
+        "cross_worker_hits": fleet.get("hits", 0),
+        "cross_worker_hit_ratio": cross_ratio,
+        "shm_publishes": fleet.get("publishes", 0),
+        "corrupt_served": fleet.get("corrupt_served", 0),
+        "cpus": os.cpu_count() or 1,
+    }
+    print(json.dumps(row), flush=True)
+    fails = []
+    if off["rate"] == 0 or on["rate"] == 0:
+        fails.append("an arm produced zero requests")
+    if fleet.get("hits", 0) == 0:
+        fails.append("shm tier never produced a cross-worker hit")
+    if fleet.get("corrupt_served", 0):
+        fails.append("corrupt bytes served from the shm tier")
+    if ratio < 1.0:
+        fails.append(f"shm tier LOST to independent caches ({ratio}x)")
+    if fails:
+        for f in fails:
+            print(f"[workers] SHM A/B FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[workers] SHM A/B PASS: {off['rate']:.1f} -> {on['rate']:.1f} "
+          f"req/s ({ratio}x) at N={n}, cross-worker hit ratio "
+          f"{cross_ratio}", file=sys.stderr)
+    return 0
+
+
 def main() -> None:
     duration = float(os.environ.get("BENCH_DURATION", "12"))
     n_threads = int(os.environ.get("BENCH_THREADS", "16"))
@@ -99,6 +301,9 @@ def main() -> None:
         ratio = results[1]["value"] / results[0]["value"]
         print(f"[workers] N={counts[1]}/N={counts[0]} ratio: {ratio:.2f}x "
               f"on a {os.cpu_count()}-core host", file=sys.stderr)
+    if os.environ.get("BENCH_SHM_AB", "1") != "0":
+        if shm_ab(duration, n_threads) != 0:
+            raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -228,9 +228,18 @@ def strong_etag(key: tuple) -> str:
     """Strong ETag for a request key. sha256 over the digest plus the
     deterministic repr of the canonical options tuple (primitives only,
     so repr is stable across processes of the same build)."""
+    return '"' + shared_key(key).hex()[:32] + '"'
+
+
+def shared_key(key: tuple) -> bytes:
+    """32-byte cross-process spelling of a request key, for the fleet
+    shm tier (fleet/shmcache.py slots are keyed by fixed-width bytes).
+    Same derivation the strong ETag truncates — the repr of the
+    canonical tuple is primitives-only and stable across processes of
+    the same build, which is exactly the fleet's process set."""
     h = hashlib.sha256(key[0])
     h.update(repr(key[1:]).encode())
-    return '"' + h.hexdigest()[:32] + '"'
+    return h.digest()
 
 
 def etag_matches(header: str, etag: str) -> bool:
@@ -269,6 +278,13 @@ class CacheSet:
             ttl_s=source_ttl_s, on_evict=_ev("source_evictions"))
         self.coalesce = bool(coalesce)
         self.flight = Singleflight(stats=s)
+        # fleet shm tier (fleet/shmcache.py), attached by ImageService
+        # when --fleet-cache-mb is set; None = single-tier (parity).
+        # Deliberately NOT shrunk by apply_pressure: the file is a
+        # shared resource — one worker's local RSS pressure must not
+        # evict its siblings' hits (the mapping is file-backed and
+        # reclaimable by the kernel anyway).
+        self.shm = None
         # pristine budgets, restored when pressure recedes (the brownout
         # ladder below mutates the live ones)
         self._base_budgets = (self.result.budget, self.frames.budget,
@@ -313,10 +329,51 @@ class CacheSet:
             source_mb=getattr(o, "cache_source_mb", 32.0),
         )
 
+    def attach_shm(self, shm) -> None:
+        self.shm = shm
+
     @property
     def keyed(self) -> bool:
         """Whether any tier needs the content-addressed request key."""
-        return self.result.enabled or self.coalesce
+        return self.result.enabled or self.coalesce or self.shm is not None
+
+    # -- fleet shm tier (local LRU -> shm tiered result lookup) ----------
+
+    def shm_lookup(self, key: tuple):
+        """(ProcessedImage, placement) from the fleet tier, or None.
+        Checksum-verified by the tier itself; any failure — corrupt
+        entry, unparseable meta, a tier error — degrades to a miss,
+        never to a failed request (the cache.get failpoint contract)."""
+        if self.shm is None:
+            return None
+        try:
+            got = self.shm.get(shared_key(key))
+        except Exception:
+            got = None  # a failing tier reads as a miss (see ByteBudgetLRU.get)
+        if got is None:
+            return None
+        meta, body = got
+        try:
+            mime, _, placement = meta.decode("utf-8").partition("\n")
+        except UnicodeDecodeError:
+            return None
+        from imaginary_tpu.pipeline import ProcessedImage
+
+        return ProcessedImage(body=body, mime=mime), placement
+
+    def shm_store(self, key: tuple, out, placement: str) -> None:
+        """Best-effort deposit: a refused publish (fenced, oversize,
+        contended, injected fault) costs a future miss, nothing else."""
+        if self.shm is None:
+            return
+        meta = (out.mime + "\n" + (placement or "")).encode("utf-8")
+        try:
+            self.shm.put(shared_key(key), meta, out.body)
+        except Exception:
+            # deliberate swallow: the deposit is advisory — the response
+            # was already produced and must ship regardless (an injected
+            # fleet.write timeout lands here)
+            self.shm.stats.publish_contended += 1
 
     def to_dict(self) -> dict:
         """Executor.stats()-style reporting for /health and /metrics."""

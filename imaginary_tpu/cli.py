@@ -320,6 +320,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving processes on one port via SO_REUSEPORT "
                         "(0 = one per CPU core); worker 0 owns the device, "
                         "the rest serve on the host backend")
+    # fleet tier (imaginary_tpu/fleet/): crash-safe shared result cache
+    # + worker fencing + rolling restarts; defaults OFF (no shm file is
+    # created, byte parity with the single-process build)
+    p.add_argument("--fleet-cache-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_FLEET_CACHE_MB", 0.0),
+                   help="byte budget in MB for the crash-safe mmap result "
+                        "cache shared by all local workers (sealed "
+                        "checksummed entries, torn-write detection, "
+                        "worker fencing via generation epochs); 0 "
+                        "disables the fleet data plane")
+    p.add_argument("--fleet-roll-grace", type=float,
+                   default=_env_float("IMAGINARY_TPU_FLEET_ROLL_GRACE", 5.0),
+                   help="SIGHUP rolling restart: seconds an old worker "
+                        "keeps finishing in-flight work after its "
+                        "replacement reports ready and it stops "
+                        "accepting, before SIGTERM starts its normal "
+                        "shutdown drain")
+    p.add_argument("--read-timeout", type=float,
+                   default=_env_float("IMAGINARY_TPU_READ_TIMEOUT", 0.0),
+                   help="close a connection whose request read (headers "
+                        "or body) goes this many seconds without a byte "
+                        "— slow-client/slowloris hardening so a stalled "
+                        "read cannot pin a worker slot through a rolling "
+                        "drain; 0 disables (parity)")
     p.add_argument("--batch-window-ms", type=float,
                    default=_env_float("IMAGINARY_TPU_BATCH_WINDOW_MS", 3.0),
                    help="micro-batch window (convoy policy only)")
@@ -527,6 +551,9 @@ def options_from_args(args) -> ServerOptions:
         cpus=args.cpus,
         endpoints=parse_endpoints(args.disable_endpoints),
         workers=_resolve_workers(args.workers),
+        fleet_cache_mb=max(0.0, args.fleet_cache_mb),
+        fleet_roll_grace_s=max(0.0, args.fleet_roll_grace),
+        read_timeout_s=max(0.0, args.read_timeout),
         max_queue_ms=max(0.0, args.max_queue_ms),
         request_timeout_s=max(0.0, args.request_timeout),
         source_retries=max(0, args.source_retries),
@@ -594,14 +621,34 @@ def main(argv=None) -> int:
     from imaginary_tpu.web.workers import WORKER_ENV, run_supervisor, worker_index
 
     if o.workers > 1 and WORKER_ENV not in os.environ:
+        # refuse loudly BEFORE any worker pays a jax import: without
+        # SO_REUSEPORT the fleet would crash-loop on late bind failures
+        from imaginary_tpu.web.workers import check_reuseport
+
+        check_reuseport()
         # liveness probe target: /health is a PUBLIC_PATHS route, so no
         # key rides along; a TLS-only fleet is probed with verification
         # off (the supervisor talks to its own children over loopback)
         scheme = "https" if o.cert_file and o.key_file else "http"
         health_url = (f"{scheme}://127.0.0.1:{o.port}"
                       f"{o.path_prefix.rstrip('/')}/health")
-        return run_supervisor(list(argv) if argv is not None else sys.argv[1:],
-                              o.workers, health_url=health_url)
+        # fleet shared cache: the supervisor creates the file (one per
+        # fleet) and every worker attaches via IMAGINARY_TPU_FLEET_PATH;
+        # the supervisor keeps the handle to stamp fencing epochs
+        fleet = None
+        if o.fleet_cache_mb > 0:
+            from imaginary_tpu.fleet import shmcache
+
+            fleet = shmcache.ShmCache.create_for_fleet(o.fleet_cache_mb)
+            os.environ[shmcache.PATH_ENV] = fleet.path
+        try:
+            return run_supervisor(
+                list(argv) if argv is not None else sys.argv[1:],
+                o.workers, health_url=health_url, fleet=fleet,
+                roll_grace_s=o.fleet_roll_grace_s)
+        finally:
+            if fleet is not None:
+                fleet.close()
     if worker_index() > 0:
         # non-owner workers are CPU-pinned BY DESIGN (the chip accepts one
         # client); --require-device is worker 0's guarantee — enforcing it

@@ -58,6 +58,17 @@ Sites (each named for the subsystem boundary it sits on):
   codec.bomb       the pre-decode bomb gate (codecs/__init__.py): an
                    injected error rejects the decode 413 exactly as a
                    header-dimension bomb would
+  fleet.write      inside a shared-cache slot deposit, between acquire
+                   and seal (fleet/shmcache.py); keyable by worker
+                   index — arm with delay() and SIGKILL the worker to
+                   leave a real torn (WRITING, lock-released) slot, the
+                   crash shape the sweeper + reader-skip exist for; an
+                   error() abandons the deposit cleanly
+  worker.zombie    the shared-cache publish gate (fleet/shmcache.py);
+                   keyable by worker index — an injected error makes
+                   the worker behave as a DEPOSED zombie (publish
+                   refused + fenced counter) without needing a real
+                   supervisor replacement cycle
 
 Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
 
@@ -106,6 +117,8 @@ SITES = (
     "device.corrupt",
     "device.slow",
     "codec.bomb",
+    "fleet.write",
+    "worker.zombie",
 )
 
 # keyed-site spelling: site[key], key limited to a safe token charset

@@ -1,0 +1,9 @@
+"""Fleet tier: multi-process robustness primitives.
+
+`web/workers.py` gives the fleet its control plane (SO_REUSEPORT
+supervisor, liveness probing, rolling restarts); this package holds the
+DATA plane pieces every local worker shares — today the crash-safe
+mmap-backed result cache (shmcache.py) and the worker-fencing epoch
+table it carries. Everything here is stdlib-only and import-light: the
+supervisor process attaches it without paying a jax import.
+"""

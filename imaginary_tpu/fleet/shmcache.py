@@ -1,0 +1,511 @@
+"""Crash-safe shared result cache + worker-fencing epochs (mmap).
+
+Every local worker process maps ONE file (tmpfs when available) holding
+a content-addressed result cache, so a hot entry computed by any worker
+serves the whole fleet — and a worker SIGKILLed mid-anything must never
+be able to corrupt what its siblings serve. The design earns that the
+same way PR 10 earned multi-chip: assume a process can die, lie, or lag
+at any byte boundary.
+
+Layout (one header page, then fixed-size slots):
+
+    +--------------------------------------------------------------+
+    | magic | nslots | slot_bytes | lru tick | worker epoch table  |
+    +--------------------------------------------------------------+
+    | slot 0: state | epoch | tick | lens | key | checksum | data  |
+    | slot 1: ...                                                  |
+    +--------------------------------------------------------------+
+
+Entries are direct-mapped by the first 8 bytes of the (sha256) key with
+a small associative probe window; an entry larger than one slot is
+simply not cached (the local LRU tier still holds it).
+
+Crash safety is a two-phase write-then-publish protocol:
+
+  1. `_slot_acquire`: take the slot's EXCLUSIVE byte-range lock
+     (fcntl.lockf — the kernel releases it if the writer dies) and
+     stamp the slot WRITING.
+  2. deposit payload + header + blake2b checksum, then publish by
+     flipping state to SEALED — the LAST write, so a reader can never
+     observe a SEALED slot with a half-written body.
+  3. `_slot_abandon` (always, in a `finally`): an unpublished slot is
+     reset FREE and the lock released. itpucheck rule ITPU009 pins this
+     acquire -> publish-or-abandon-in-finally shape statically.
+
+A writer SIGKILLed between 1 and 2 leaves a WRITING slot whose lock the
+kernel already released: readers skip it (state != SEALED) and the next
+writer — or an explicit `sweep()` — reclaims it (`torn_reclaimed`).
+Readers take the SHARED lock, so a checksum mismatch on a SEALED entry
+is never a benign race: it is corruption (bit rot, a scribbler, a torn
+page) and is counted, reclaimed, and served as a MISS — never as bytes
+(`corrupt_served` exists as the tripwire counter the chaos row pins 0).
+
+Worker fencing: the supervisor owns the epoch table. Every (re)spawn of
+worker index i stamps `epochs[i]` with a fleet-monotonic epoch and
+hands the same number to the child (env). A deposed worker — declared
+hung, replacement already stamped+spawned — that wakes up finds the
+table ahead of its own epoch: it MAY read (stale reads of sealed
+immutable entries are safe) but may NOT publish, which closes the
+zombie-writer race the spawn-first replacement policy opened in PR 6.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import mmap
+import os
+import struct
+import tempfile
+import threading
+from typing import Optional
+
+from imaginary_tpu import failpoints
+
+MAGIC = b"ITPUFLT1"
+HEADER_BYTES = 4096  # one page: magic/geometry/tick + the epoch table
+MAX_WORKERS = 64
+SLOT_BYTES = 128 * 1024  # entries above ~128 KB stay local-tier-only
+ASSOC = 4  # direct-mapped with a 4-way probe window
+
+# header field offsets
+_OFF_MAGIC = 0
+_OFF_NSLOTS = 8
+_OFF_SLOT_BYTES = 12
+_OFF_TICK = 16
+_OFF_EPOCHS = 24  # MAX_WORKERS x u64
+
+# slot header: state u32 | epoch u64 | tick u64 | meta_len u32 |
+# body_len u32 | key 32s | checksum 16s
+_SLOT_HDR = struct.Struct("<IQQII32s16s")
+_SLOT_DATA_OFF = 96  # header rounded up; payload starts here
+FREE, WRITING, SEALED = 0, 1, 2
+
+PATH_ENV = "IMAGINARY_TPU_FLEET_PATH"
+
+
+@dataclasses.dataclass
+class FleetStats:
+    """Process-local counters for this process's traffic against the
+    SHARED cache (each worker reports its own view; the slot scan in
+    snapshot() is the shared ground truth)."""
+
+    hits: int = 0
+    misses: int = 0
+    publishes: int = 0
+    # publish attempts refused before any write: oversize payload, or
+    # every candidate slot exclusively locked by a live writer
+    publish_oversize: int = 0
+    publish_contended: int = 0
+    # publishes refused because this worker's epoch is fenced (a
+    # replacement was stamped; this process is a deposed zombie)
+    fenced_publishes: int = 0
+    # WRITING slots whose writer died mid-deposit, reclaimed by a later
+    # writer or sweep()
+    torn_reclaimed: int = 0
+    # SEALED entries whose checksum failed verification: counted,
+    # reclaimed, degraded to a miss
+    corrupt: int = 0
+    # the tripwire: responses served from an entry that FAILED
+    # verification. No code path increments it — the chaos harness pins
+    # it 0 so any future bypass of verify-before-serve trips the gate.
+    corrupt_served: int = 0
+    evictions: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Slot:
+    """An acquired slot: index, the state it was taken over from, and
+    whether the deposit was published."""
+
+    __slots__ = ("idx", "prev_state", "published")
+
+    def __init__(self, idx: int, prev_state: int):
+        self.idx = idx
+        self.prev_state = prev_state
+        self.published = False
+
+
+def _checksum(key: bytes, epoch: int, meta: bytes, body: bytes) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(key)
+    h.update(struct.pack("<QII", epoch, len(meta), len(body)))
+    h.update(meta)
+    h.update(body)
+    return h.digest()
+
+
+def default_path() -> str:
+    """Fleet file location: tmpfs when the host has one (the whole point
+    is page-cache-speed IPC), else the temp dir."""
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"imaginary-fleet-{os.getpid()}.shm")
+
+
+class ShmCache:
+    """One process's handle on the shared cache file.
+
+    All lock traffic is fcntl byte-range locks on the slot's first byte:
+    advisory, per-process, and — the property everything rests on —
+    RELEASED BY THE KERNEL when the holder dies, however it dies. Within
+    one process a plain mutex serializes access (POSIX record locks do
+    not exclude threads of the same process)."""
+
+    def __init__(self, path: str, *, create: bool, size_mb: float = 0.0,
+                 worker: int = 0, epoch: int = 0, owner: bool = False):
+        self.path = path
+        self.worker = max(0, min(int(worker), MAX_WORKERS - 1))
+        self.epoch = int(epoch)
+        self.owner = owner
+        self.stats = FleetStats()
+        self._lock = threading.Lock()
+        if create:
+            nslots = max(8, int(size_mb * 1e6) // SLOT_BYTES)
+            total = HEADER_BYTES + nslots * SLOT_BYTES
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            try:
+                os.ftruncate(fd, total)
+            except OSError:
+                os.close(fd)
+                raise
+            self._fd = fd
+            self._mm = mmap.mmap(fd, total)
+            self._mm[_OFF_NSLOTS:_OFF_NSLOTS + 4] = struct.pack("<I", nslots)
+            self._mm[_OFF_SLOT_BYTES:_OFF_SLOT_BYTES + 4] = struct.pack(
+                "<I", SLOT_BYTES)
+            self._mm[_OFF_TICK:_OFF_TICK + 8] = struct.pack("<Q", 1)
+            # magic LAST: an attacher that raced the create never maps a
+            # half-initialized header
+            self._mm[_OFF_MAGIC:_OFF_MAGIC + 8] = MAGIC
+            self.nslots = nslots
+        else:
+            fd = os.open(path, os.O_RDWR)
+            size = os.fstat(fd).st_size
+            self._fd = fd
+            self._mm = mmap.mmap(fd, size)
+            if self._mm[_OFF_MAGIC:_OFF_MAGIC + 8] != MAGIC:
+                self._mm.close()
+                os.close(fd)
+                raise ValueError(
+                    f"{path} is not an imaginary-tpu fleet cache file")
+            (self.nslots,) = struct.unpack_from("<I", self._mm, _OFF_NSLOTS)
+            (slot_bytes,) = struct.unpack_from(
+                "<I", self._mm, _OFF_SLOT_BYTES)
+            if slot_bytes != SLOT_BYTES:
+                self._mm.close()
+                os.close(fd)
+                raise ValueError(
+                    f"{path} slot geometry {slot_bytes} != {SLOT_BYTES} "
+                    "(fleet processes must run the same build)")
+        # the creator stamps its own epoch so a standalone single
+        # process (no supervisor) is never fenced against itself
+        if create:
+            self.stamp_epoch(self.worker, self.epoch)
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def create_for_fleet(cls, size_mb: float,
+                         path: Optional[str] = None) -> "ShmCache":
+        """Supervisor-side create: builds the file before any worker
+        spawns (children attach via PATH_ENV). The supervisor itself
+        never publishes — it only stamps epochs."""
+        path = path or os.environ.get(PATH_ENV, "") or default_path()
+        return cls(path, create=True, size_mb=size_mb, owner=True)
+
+    @classmethod
+    def from_options(cls, o, worker: int = 0, epoch: int = 0) -> Optional["ShmCache"]:
+        """Worker-side build: attach the supervisor's file when the env
+        names one, else create a standalone file (single-process mode —
+        the tier still works, it just has no siblings yet)."""
+        size_mb = float(getattr(o, "fleet_cache_mb", 0.0) or 0.0)
+        if size_mb <= 0:
+            return None
+        env_path = os.environ.get(PATH_ENV, "")
+        if env_path:
+            return cls(env_path, create=False, worker=worker, epoch=epoch)
+        return cls(default_path(), create=True, size_mb=size_mb,
+                   worker=worker, epoch=epoch, owner=True)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+            os.close(self._fd)
+        except (OSError, ValueError):  # itpu: allow[ITPU004] double-close during teardown races is benign
+            pass
+        if self.owner:
+            try:
+                os.unlink(self.path)
+            except OSError:  # itpu: allow[ITPU004] another owner already unlinked; nothing to leak
+                pass
+
+    # -- locks -----------------------------------------------------------
+
+    def _slot_off(self, idx: int) -> int:
+        return HEADER_BYTES + idx * SLOT_BYTES
+
+    def _try_lock(self, idx: int, exclusive: bool) -> bool:
+        import fcntl
+
+        kind = fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH
+        try:
+            fcntl.lockf(self._fd, kind | fcntl.LOCK_NB, 1,
+                        self._slot_off(idx))
+            return True
+        except OSError:
+            return False
+
+    def _unlock(self, idx: int) -> None:
+        import fcntl
+
+        try:
+            fcntl.lockf(self._fd, fcntl.LOCK_UN, 1, self._slot_off(idx))
+        except OSError:  # itpu: allow[ITPU004] unlock of a lock lost to fd teardown; kernel already released it
+            pass
+
+    # -- header ----------------------------------------------------------
+
+    def _next_tick(self) -> int:
+        (t,) = struct.unpack_from("<Q", self._mm, _OFF_TICK)
+        struct.pack_into("<Q", self._mm, _OFF_TICK, t + 1)
+        return t
+
+    def stamp_epoch(self, idx: int, epoch: int) -> None:
+        """Supervisor-side: record worker idx's CURRENT legitimate epoch.
+        Stamped BEFORE the replacement spawns, so the deposed process is
+        fenced from the instant its successor exists on paper."""
+        idx = max(0, min(int(idx), MAX_WORKERS - 1))
+        struct.pack_into("<Q", self._mm, _OFF_EPOCHS + idx * 8, int(epoch))
+
+    def epoch_of(self, idx: int) -> int:
+        idx = max(0, min(int(idx), MAX_WORKERS - 1))
+        (e,) = struct.unpack_from("<Q", self._mm, _OFF_EPOCHS + idx * 8)
+        return e
+
+    def fenced(self) -> bool:
+        """True when a successor for this worker index has been stamped:
+        this process may read but must not publish."""
+        return self.epoch_of(self.worker) != self.epoch
+
+    # -- slot primitives (the ITPU009 protocol) --------------------------
+
+    def _slot_hdr(self, idx: int) -> tuple:
+        return _SLOT_HDR.unpack_from(self._mm, self._slot_off(idx))
+
+    def _slot_state(self, idx: int) -> int:
+        (s,) = struct.unpack_from("<I", self._mm, self._slot_off(idx))
+        return s
+
+    def _slot_acquire(self, idx: int) -> Optional[_Slot]:
+        """Phase 1: exclusive-lock the slot and mark it WRITING. Returns
+        None when a live writer holds it. A WRITING state found UNDER a
+        freshly-won lock can only mean the previous writer died
+        mid-deposit — the kernel freed its lock — so the slot is
+        reclaimed here."""
+        if not self._try_lock(idx, exclusive=True):
+            return None
+        prev = self._slot_state(idx)
+        if prev == WRITING:
+            self.stats.torn_reclaimed += 1
+        struct.pack_into("<I", self._mm, self._slot_off(idx), WRITING)
+        return _Slot(idx, prev)
+
+    def _slot_publish(self, slot: _Slot) -> None:
+        """Phase 2: seal. The state flip is the LAST write of a deposit;
+        everything under the checksum is already in place."""
+        struct.pack_into("<I", self._mm, self._slot_off(slot.idx), SEALED)
+        slot.published = True
+        self.stats.publishes += 1
+
+    def _slot_abandon(self, slot: _Slot) -> None:
+        """Always runs (finally): an unpublished deposit is reset FREE —
+        a deliberate abandon reclaims immediately; only a writer DEATH
+        leaves WRITING behind for the sweeper. Releases the lock."""
+        if not slot.published:
+            struct.pack_into("<I", self._mm, self._slot_off(slot.idx), FREE)
+        self._unlock(slot.idx)
+
+    # -- cache operations ------------------------------------------------
+
+    def _candidates(self, key: bytes) -> list:
+        base = int.from_bytes(key[:8], "little") % self.nslots
+        return [(base + j) % self.nslots for j in range(min(ASSOC,
+                                                            self.nslots))]
+
+    def get(self, key: bytes) -> Optional[tuple]:
+        """(meta, body) for a sealed, checksum-verified entry; None on
+        miss. A verification failure counts `corrupt`, reclaims the
+        slot, and reads as a miss — corrupt bytes are never returned."""
+        with self._lock:
+            for idx in self._candidates(key):
+                if self._slot_state(idx) != SEALED:
+                    continue
+                # shared lock: excludes live writers, so any checksum
+                # mismatch past this point is real corruption, not a race
+                if not self._try_lock(idx, exclusive=False):
+                    continue
+                try:
+                    state, epoch, _tick, meta_len, body_len, skey, csum = \
+                        self._slot_hdr(idx)
+                    if state != SEALED or skey != key:
+                        continue
+                    off = self._slot_off(idx) + _SLOT_DATA_OFF
+                    payload = bytes(self._mm[off:off + meta_len + body_len])
+                finally:
+                    self._unlock(idx)
+                meta = payload[:meta_len]
+                body = payload[meta_len:]
+                if _checksum(key, epoch, meta, body) != csum:
+                    self.stats.corrupt += 1
+                    self._reclaim(idx)
+                    continue
+                # LRU recency bump: racy u64 scribble, deliberately
+                # unlocked — a torn tick mis-orders eviction, nothing else
+                struct.pack_into("<Q", self._mm, self._slot_off(idx) + 12,
+                                 self._next_tick())
+                self.stats.hits += 1
+                return meta, body
+            self.stats.misses += 1
+            return None
+
+    def put(self, key: bytes, meta: bytes, body: bytes) -> bool:
+        """Two-phase deposit; best-effort (False = not cached, never an
+        error a request should see)."""
+        try:
+            # chaos: simulate waking up deposed (the SIGSTOP zombie)
+            # without needing a real supervisor replacement cycle
+            failpoints.hit("worker.zombie", key=self.worker)
+        except failpoints.FailpointError:
+            self.stats.fenced_publishes += 1
+            return False
+        if self.fenced():
+            self.stats.fenced_publishes += 1
+            return False
+        if _SLOT_DATA_OFF + len(meta) + len(body) > SLOT_BYTES:
+            self.stats.publish_oversize += 1
+            return False
+        with self._lock:
+            for idx in self._victim_order(key):
+                slot = self._slot_acquire(idx)
+                if slot is None:
+                    continue
+                try:
+                    # chaos: a delay() here holds the slot in WRITING —
+                    # SIGKILL the process now and the torn-write story
+                    # (reader skip + sweeper reclaim) is exercised for
+                    # real; an error() abandons the deposit cleanly
+                    # (caught below — put never raises)
+                    failpoints.hit("fleet.write", key=self.worker)
+                    if slot.prev_state == SEALED \
+                            and self._slot_hdr(idx)[5] != key:
+                        self.stats.evictions += 1
+                    off = self._slot_off(idx)
+                    self._mm[off + _SLOT_DATA_OFF:
+                             off + _SLOT_DATA_OFF + len(meta) + len(body)] = \
+                        meta + body
+                    _SLOT_HDR.pack_into(
+                        self._mm, off, WRITING, self.epoch,
+                        self._next_tick(), len(meta), len(body), key,
+                        _checksum(key, self.epoch, meta, body))
+                    self._slot_publish(slot)
+                    return True
+                except failpoints.FailpointError:
+                    # injected deposit fault: the finally's abandon has
+                    # already reset the slot; the entry just isn't cached
+                    self.stats.publish_contended += 1
+                    return False
+                finally:
+                    self._slot_abandon(slot)
+            self.stats.publish_contended += 1
+            return False
+
+    def _victim_order(self, key: bytes) -> list:
+        """Candidate slots in replacement-preference order: same key
+        (refresh), FREE, torn WRITING, then oldest-tick SEALED."""
+        cands = self._candidates(key)
+        same, free, torn, sealed = [], [], [], []
+        for idx in cands:
+            state, _e, tick, _ml, _bl, skey, _c = self._slot_hdr(idx)
+            if state == SEALED and skey == key:
+                same.append(idx)
+            elif state == FREE:
+                free.append(idx)
+            elif state == WRITING:
+                torn.append(idx)
+            else:
+                sealed.append((tick, idx))
+        return same + free + torn + [i for _, i in sorted(sealed)]
+
+    def _reclaim(self, idx: int) -> None:
+        """Reset a corrupt/torn slot to FREE, if no live writer holds it."""
+        if not self._try_lock(idx, exclusive=True):
+            return
+        try:
+            struct.pack_into("<I", self._mm, self._slot_off(idx), FREE)
+        finally:
+            self._unlock(idx)
+
+    def sweep(self) -> int:
+        """Reclaim every torn slot (WRITING with no live lock holder).
+        Writers reclaim opportunistically on collision; this full scan is
+        for the maintenance ticker and the chaos harness."""
+        reclaimed = 0
+        with self._lock:
+            for idx in range(self.nslots):
+                if self._slot_state(idx) != WRITING:
+                    continue
+                if not self._try_lock(idx, exclusive=True):
+                    continue  # a live writer is mid-deposit; not torn
+                try:
+                    if self._slot_state(idx) == WRITING:
+                        struct.pack_into("<I", self._mm,
+                                         self._slot_off(idx), FREE)
+                        reclaimed += 1
+                finally:
+                    self._unlock(idx)
+        self.stats.torn_reclaimed += reclaimed
+        return reclaimed
+
+    # -- introspection ---------------------------------------------------
+
+    def slot_scan(self) -> dict:
+        """Shared ground truth: per-state slot counts + sealed bytes."""
+        counts = {"free": 0, "writing": 0, "sealed": 0}
+        sealed_bytes = 0
+        for idx in range(self.nslots):
+            state, _e, _t, meta_len, body_len, _k, _c = self._slot_hdr(idx)
+            if state == SEALED:
+                counts["sealed"] += 1
+                sealed_bytes += meta_len + body_len
+            elif state == WRITING:
+                counts["writing"] += 1
+            else:
+                counts["free"] += 1
+        counts["sealed_bytes"] = sealed_bytes
+        return counts
+
+    def snapshot(self) -> dict:
+        """The /health `fleet` block."""
+        out = {
+            "worker": self.worker,
+            "epoch": self.epoch,
+            "stamped_epoch": self.epoch_of(self.worker),
+            "fenced": self.fenced(),
+            "slots": self.nslots,
+            "slot_bytes": SLOT_BYTES,
+        }
+        out.update(self.slot_scan())
+        out.update(self.stats.to_dict())
+        return out
+
+    def debug_snapshot(self) -> dict:
+        """The /debugz `fleet` block: snapshot + the epoch table."""
+        out = self.snapshot()
+        out["path"] = self.path
+        out["epochs"] = {
+            str(i): self.epoch_of(i) for i in range(MAX_WORKERS)
+            if self.epoch_of(i) != 0
+        }
+        return out

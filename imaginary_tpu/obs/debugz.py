@@ -98,6 +98,12 @@ def debug_payload(service) -> dict:
             "estimated_queue_ms": round(service.estimated_queue_ms(), 3),
         }
         payload["cache"] = service.caches.to_dict()
+        shm = service.caches.shm
+        if shm is not None:
+            # fleet shared cache: snapshot + file path + the whole epoch
+            # table (diagnosing a fencing dispute wants every stamp, not
+            # just this worker's)
+            payload["fleet"] = shm.debug_snapshot()
         governor = getattr(service, "pressure", None)
         if governor is not None:
             # governor rung + sampled signals + the full recent

@@ -31,6 +31,9 @@ Rules (one thin module per rule under tools/rules/):
            _total, every family carries HELP text
   ITPU008  pool submissions that carry a request must ride
            contextvars.copy_context() (trace/deadline/bomb-cap loss class)
+  ITPU009  shm slot acquire without publish-or-abandon in a `finally`
+           (locked-WRITING-slot leak class, the fleet-cache analogue of
+           the ITPU003 ledger rule)
 
 Suppression grammar (same-line, or a standalone comment covering the
 next code line); the reason is REQUIRED — a blanket suppression is

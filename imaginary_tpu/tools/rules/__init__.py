@@ -15,6 +15,7 @@ from imaginary_tpu.tools.rules import (
     ledger,
     metrics_exposition,
     silent_except,
+    slot_protocol,
 )
 
 RULES = (
@@ -26,4 +27,5 @@ RULES = (
     failpoint_registry,
     metrics_exposition,
     context_propagation,
+    slot_protocol,
 )

@@ -239,6 +239,8 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
     h2_server = None
     h2_client = None
     hop_dir = None
+    plain_server = None
+    site = None
     try:
         if ssl_ctx is not None and _h2_active(o):
             # HTTP/2 termination (web/http2.py): an internal h1 listener
@@ -288,6 +290,19 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
                 ssl=ssl_ctx,
                 reuse_port=o.workers > 1 or None,
             )
+        elif o.read_timeout_s > 0:
+            # slow-client hardening (web/ingress.py): the listener wraps
+            # every connection in the read-inactivity guard. Installed at
+            # the protocol factory, so it needs the raw create_server
+            # path rather than TCPSite; the TLS+h2 terminator keeps its
+            # own dispatcher (h2 flow control already bounds stalls).
+            from imaginary_tpu.web.ingress import ReadTimeoutGuard
+
+            loop_ = asyncio.get_running_loop()
+            plain_server = await loop_.create_server(
+                lambda: ReadTimeoutGuard(runner.server(), o.read_timeout_s),
+                o.address or None, o.port, ssl=ssl_ctx,
+                reuse_port=o.workers > 1 or None)
         else:
             site = web.TCPSite(runner, o.address or None, o.port, ssl_context=ssl_ctx,
                                reuse_port=o.workers > 1 or None)
@@ -297,6 +312,32 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
+
+        def stop_accepting():
+            # rolling restart (web/workers.py): SIGUSR1 closes the
+            # LISTENER only — SO_REUSEPORT routes new connections to the
+            # replacement worker while in-flight and keep-alive requests
+            # here run to completion; the supervisor's SIGTERM (after the
+            # roll grace) then runs the normal draining shutdown
+            print("imaginary-tpu: SIGUSR1 — listener closed, draining "
+                  "in-flight work")
+            if h2_server is not None:
+                h2_server.close()
+            elif plain_server is not None:
+                plain_server.close()
+            elif site is not None:
+                asyncio.ensure_future(site.stop())
+
+        loop.add_signal_handler(signal.SIGUSR1, stop_accepting)
+        # SIGHUP is the SUPERVISOR's roll trigger. It often arrives at
+        # the whole process GROUP (a terminal hangup, an init system, a
+        # signal-forwarding wrapper) — and a worker's default disposition
+        # would be to die on the spot, turning "roll the fleet" into
+        # "kill every worker at once". Serving processes ignore it.
+        loop.add_signal_handler(
+            signal.SIGHUP,
+            lambda: print("imaginary-tpu: SIGHUP ignored (rolling "
+                          "restarts are driven by the supervisor)"))
 
         async def memory_release():
             # Role of the reference's FreeOSMemory ticker
@@ -310,6 +351,12 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
             while not stop.is_set():
                 await asyncio.sleep(max(mrelease, 1))
                 release_memory()
+                shm = app["service"].caches.shm
+                if shm is not None:
+                    # the fleet sweeper: reclaim slots whose writers died
+                    # mid-deposit (writers also reclaim on collision;
+                    # this bounds how long a torn slot can sit)
+                    shm.sweep()
 
         ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
         scheme = "https" if o.cert_file and o.key_file else "http"
@@ -343,6 +390,9 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
                 await asyncio.sleep(0.05)
         if h2_client is not None:
             await h2_client.close()
+        if plain_server is not None and plain_server.sockets is not None:
+            plain_server.close()
+            await plain_server.wait_closed()
         await asyncio.wait_for(runner.cleanup(), timeout=5)
     finally:
         # unconditional: a failed boot (port taken, bind error) or a

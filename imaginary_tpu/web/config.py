@@ -122,6 +122,24 @@ class ServerOptions:
     # Fraction of its dispatch rotation a degraded device keeps (0 =
     # full shed; recovery then rides the golden probe's timed runs).
     failslow_share: float = 0.0
+    # --- fleet tier (imaginary_tpu/fleet/ + web/workers.py) ------------------
+    # Byte budget in MB for the crash-safe shared result cache mapped by
+    # every local worker (fleet/shmcache.py). 0 = the whole fleet data
+    # plane OFF (parity: no file is created, no shm branch ever runs,
+    # single-process responses are byte-identical to the pre-fleet
+    # build). Under a supervisor the file is created once and workers
+    # attach via IMAGINARY_TPU_FLEET_PATH.
+    fleet_cache_mb: float = 0.0
+    # Rolling-restart drain grace in seconds: after a SIGHUP roll's
+    # replacement reports ready, the old worker stops accepting
+    # (SIGUSR1) and gets this long to finish in-flight work before
+    # SIGTERM starts its normal shutdown drain.
+    fleet_roll_grace_s: float = 5.0
+    # Ingress slow-client hardening: close a connection whose request
+    # read (headers or body) goes this many seconds without a byte —
+    # the slowloris shape that would otherwise pin a worker slot
+    # through a rolling drain. 0 = off (parity; aiohttp defaults).
+    read_timeout_s: float = 0.0
     # --- multi-tenant QoS (imaginary_tpu/qos/) -------------------------------
     # Tenant table + scheduler/shed knobs: inline JSON (starts with '{')
     # or a file path; parsed once at assembly (qos/tenancy.load_policy).

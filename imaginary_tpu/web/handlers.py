@@ -103,6 +103,17 @@ class ImageService:
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
         self.caches = cache_mod.CacheSet.from_options(o)
+        if o.fleet_cache_mb > 0:
+            # fleet shm tier (fleet/shmcache.py): under a supervisor the
+            # file was created before this worker spawned and rides in
+            # via IMAGINARY_TPU_FLEET_PATH; a single process creates its
+            # own. Identity (worker index, fencing epoch) comes from the
+            # supervisor's env stamps.
+            from imaginary_tpu.fleet.shmcache import ShmCache
+            from imaginary_tpu.web.workers import worker_epoch, worker_index
+
+            self.caches.attach_shm(ShmCache.from_options(
+                o, worker=worker_index(), epoch=worker_epoch()))
         self.frame_cache = cache_mod.FrameCache(self.caches.frames,
                                                 self.caches.stats)
         self.registry = SourceRegistry(o, caches=self.caches)
@@ -178,6 +189,8 @@ class ImageService:
         await self.registry.close()
         self.executor.shutdown()
         self.pool.shutdown(wait=False)
+        if self.caches.shm is not None:
+            self.caches.shm.close()
 
     # -- the image route handler ----------------------------------------------
 
@@ -397,7 +410,8 @@ class ImageService:
             tr.annotate(plan=hashlib.sha256(
                 repr((op_name, opts.type, qs)).encode()).hexdigest()[:16],
                 cache="off")
-        if caches.result.enabled and key is not None:
+        if (caches.result.enabled or caches.shm is not None) \
+                and key is not None:
             with obs_trace.span("cache_lookup"):
                 etag = cache_mod.strong_etag(key)
                 if request.method == "GET" and cache_mod.etag_matches(
@@ -411,19 +425,35 @@ class ImageService:
                     if vary:
                         headers["Vary"] = vary
                     return web.Response(status=304, headers=headers)
-                try:
-                    hit = caches.result.get(key)
-                except Exception:
-                    # a failing cache tier degrades to a miss, never to a
-                    # failed request (failpoint cache.get proves it)
-                    hit = None
+                hit = None
+                if caches.result.enabled:
+                    try:
+                        hit = caches.result.get(key)
+                    except Exception:
+                        # a failing cache tier degrades to a miss, never
+                        # to a failed request (failpoint cache.get proves)
+                        hit = None
             if hit is not None:
                 caches.stats.result_hits += 1
                 if tr is not None:
                     tr.annotate(cache="result_hit")
                 out, placement = hit
                 return self._build_response(out, placement, vary, etag, o)
-            caches.stats.result_misses += 1
+            if caches.result.enabled:
+                caches.stats.result_misses += 1
+            # tiered lookup, local LRU -> fleet shm: a sibling worker may
+            # already have produced this exact response. Entries are
+            # checksum-verified by the tier; a corrupt or torn entry
+            # reads as a miss here, never as bytes.
+            shm_hit = caches.shm_lookup(key)
+            if shm_hit is not None:
+                out, placement = shm_hit
+                if caches.result.enabled:
+                    # promote: the next local hit skips the IPC copy
+                    caches.result.put(key, (out, placement), len(out.body))
+                if tr is not None:
+                    tr.annotate(cache="shm_hit")
+                return self._build_response(out, placement, vary, etag, o)
             if tr is not None:
                 tr.annotate(cache="result_miss")
 
@@ -499,6 +529,10 @@ class ImageService:
             # placement rides along so a replayed response carries the
             # same X-Imaginary-Backend facts as the run that produced it
             caches.result.put(key, (out, placement), len(out.body))
+        if key is not None:
+            # fleet deposit (no-op when the shm tier is off): two-phase
+            # write-then-publish, refused when this worker is fenced
+            caches.shm_store(key, out, placement)
         return self._build_response(out, placement, vary, etag, o)
 
     def _build_response(self, out, placement, vary, etag, o) -> web.Response:
@@ -637,6 +671,18 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
         # cache tier counters (hit/miss/eviction/coalesce), same
         # Executor.stats()-style dict /metrics renders as gauges
         stats["cache"] = service.caches.to_dict()
+        if service.caches.shm is not None:
+            # fleet shared-cache block (fleet/shmcache.py): this
+            # worker's epoch/fence state, the shared slot-table scan,
+            # and its process-local hit/publish/corrupt/reclaim
+            # counters; absent with --fleet-cache-mb off — the block's
+            # presence IS the armed/parity signal
+            stats["fleet"] = service.caches.shm.snapshot()
+        if service.options.read_timeout_s > 0:
+            # ingress read-guard counters (web/ingress.py)
+            from imaginary_tpu.web.ingress import STATS as ingress_stats
+
+            stats["ingress"] = ingress_stats.to_dict()
     return stats
 
 

@@ -41,9 +41,14 @@ def get_health_stats(executor=None, qos=None, pressure=None) -> dict:
         # fleet needs to attribute /health samples to processes
         "pid": os.getpid(),
     }
-    from imaginary_tpu.web.workers import worker_index
+    from imaginary_tpu.web.workers import worker_epoch, worker_index
 
     stats["worker"] = worker_index()
+    # the supervisor-stamped fencing generation (web/workers.py): the
+    # rolling-restart harness asserts these are monotonic per index, and
+    # the roll's ready-gate matches on (worker, epoch) since SO_REUSEPORT
+    # makes the old and new holder of an index indistinguishable by port
+    stats["epoch"] = worker_epoch()
     try:
         import jax
 

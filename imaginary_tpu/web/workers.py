@@ -22,8 +22,10 @@ fork would orphan), forwards SIGTERM/SIGINT so every worker runs its own
 graceful 5 s drain, and supervises LIVENESS, not just exit status:
 
   * crash: an exited worker respawns under a rolling-hour budget with
-    EXPONENTIAL BACKOFF (a boot-crash loop must converge to slow
-    retries, not spin at one jax-import per iteration);
+    exponential backoff + FULL JITTER (a correlated fleet death — bad
+    mount, shared OOM — must not respawn in lockstep and re-create the
+    thundering herd that killed it; same fix PR 4 applied to origin
+    retries);
   * hang: a worker whose process is alive but whose event loop is
     wedged (stuck accelerator runtime, blocked loop — the failure
     `worker.hang=delay(...)` injects) never exits on its own. A probe
@@ -34,17 +36,39 @@ graceful 5 s drain, and supervises LIVENESS, not just exit status:
     on a live listener while the old worker is torn down — then the
     hung worker gets SIGTERM, a drain grace, and finally SIGKILL.
 
+Worker fencing (fleet/shmcache.py): every (re)spawn is stamped with a
+fleet-monotonic EPOCH — in the child's env, and (when the shared cache
+is armed) in the shm header's epoch table, stamped BEFORE the process
+spawns. A deposed worker that wakes up after its replacement exists
+(the SIGSTOP-then-CONT zombie) finds the table ahead of its own epoch:
+it may read the shared cache but can no longer publish, closing the
+zombie-writer race that spawn-first replacement opened.
+
+Rolling restarts: SIGHUP rolls the fleet one worker at a time with zero
+listener downtime —
+
+    stamp epoch+1 -> spawn replacement -> wait for ITS /health
+    -> SIGUSR1 old (close listener; in-flight + keep-alive continue)
+    -> roll grace -> SIGTERM old (normal drain: 503 + Retry-After for
+       stragglers, 5 s in-flight completion) -> next worker
+
+so a config change or binary upgrade ships without a dropped request:
+SO_REUSEPORT keeps a ready listener on the port at every instant, and
+the drained worker's stragglers get the same Retry-After contract every
+other shed in this codebase honors.
+
 Probe-by-sampling is the honest design for SO_REUSEPORT: all workers
 share one port, so no probe can TARGET worker k — but every /health
-response carries its worker index, the kernel spreads fresh connections
-across listeners, and the probe rate scales with the fleet size so a
-healthy worker going unseen for the whole window is vanishingly
-unlikely while a hung worker is unseen by construction.
+response carries its worker index + epoch, the kernel spreads fresh
+connections across listeners, and the probe rate scales with the fleet
+size so a healthy worker going unseen for the whole window is
+vanishingly unlikely while a hung worker is unseen by construction.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -53,12 +77,16 @@ import time
 
 # env contract with cli.main: presence of WORKER_ENV marks a child (it
 # must serve, never supervise) and carries its index; reuse_port comes
-# from the child's own re-parsed --workers flag.
+# from the child's own re-parsed --workers flag. WORKER_EPOCH_ENV
+# carries the supervisor-stamped fencing epoch (0 = unsupervised).
 WORKER_ENV = "IMAGINARY_TPU_WORKER"
+WORKER_EPOCH_ENV = "IMAGINARY_TPU_WORKER_EPOCH"
 
 # A worker that dies gets this many respawns per rolling hour before the
 # supervisor gives up and shuts the fleet down (a crash loop at boot
-# would otherwise spin forever — the backoff slows it, the budget ends it).
+# would otherwise spin forever — the backoff slows it, the budget ends
+# it). Env-tunable (IMAGINARY_TPU_SUPERVISOR_RESTART_BUDGET) so tests
+# and cautious deployments can tighten it.
 MAX_RESTARTS_PER_WORKER = 5
 
 
@@ -78,9 +106,52 @@ def worker_index() -> int:
         return 0
 
 
-def _spawn(argv: list, idx: int) -> subprocess.Popen:
+def worker_epoch() -> int:
+    """This process's supervisor-stamped fencing epoch; 0 when
+    unsupervised (a standalone process stamps its own table entry 0 at
+    shm create, so it is never fenced against itself)."""
+    try:
+        return int(os.environ.get(WORKER_EPOCH_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def check_reuseport() -> None:
+    """Refuse a multi-worker boot on hosts without SO_REUSEPORT, with a
+    diagnosis — the alternative is N-1 workers crash-looping on a late
+    bind failure after each pays a full jax import."""
+    import socket
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        raise SystemExit(
+            "imaginary-tpu: --workers > 1 needs SO_REUSEPORT and this "
+            "platform's python does not expose it; run one worker per "
+            "port behind a balancer instead")
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    except OSError as e:
+        raise SystemExit(
+            "imaginary-tpu: --workers > 1 needs SO_REUSEPORT and this "
+            f"kernel refused it ({e}); run one worker per port behind a "
+            "balancer instead") from None
+    finally:
+        s.close()
+
+
+def _backoff_delay(base: float, consec: int) -> float:
+    """Respawn delay: exponential base with FULL jitter (uniform over
+    [0, cap]). Several workers dying together — the common case: shared
+    boot crash, host OOM sweep — then respawn DECORRELATED instead of
+    slamming the chip/origin in lockstep every 2^k seconds."""
+    cap = min(30.0, base * (2.0 ** max(0, consec - 1)))
+    return random.uniform(0.0, cap)
+
+
+def _spawn(argv: list, idx: int, epoch: int = 0) -> subprocess.Popen:
     env = dict(os.environ)
     env[WORKER_ENV] = str(idx)
+    env[WORKER_EPOCH_ENV] = str(epoch)
     if idx > 0:
         # non-owner workers must not race worker 0 for the chip; an
         # operator-set platform pin (or per-worker TPU_VISIBLE_DEVICES)
@@ -88,6 +159,28 @@ def _spawn(argv: list, idx: int) -> subprocess.Popen:
         env.setdefault("IMAGINARY_TPU_PLATFORM", "cpu")
     return subprocess.Popen([sys.executable, "-m", "imaginary_tpu.cli"] + argv,
                             env=env)
+
+
+def _open_health(health_url: str, timeout_s: float, ctx=None):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        health_url, headers={"Connection": "close"})
+    with urllib.request.urlopen(req, timeout=timeout_s, context=ctx) as r:
+        return json.loads(r.read())
+
+
+def _ssl_ctx_for(health_url: str):
+    if not health_url.startswith("https:"):
+        return None
+    import ssl
+
+    # a self-signed serving cert must not blind the prober
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return ctx
 
 
 class _LivenessProbe:
@@ -110,14 +203,7 @@ class _LivenessProbe:
         self._thread.start()
 
     def _loop(self) -> None:
-        import ssl
-
-        ctx = None
-        if self.health_url.startswith("https:"):
-            # a self-signed serving cert must not blind the prober
-            ctx = ssl.create_default_context()
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
+        ctx = _ssl_ctx_for(self.health_url)
         # Samples run CONCURRENTLY, one short-lived thread each: a hung
         # worker's listener keeps accepting (the backlog answers the
         # handshake, the wedged loop never answers the request), so a
@@ -134,15 +220,8 @@ class _LivenessProbe:
                              name="itpu-supervisor-sample").start()
 
     def _sample_once(self, ctx, inflight) -> None:
-        import json
-        import urllib.request
-
         try:
-            req = urllib.request.Request(
-                self.health_url, headers={"Connection": "close"})
-            with urllib.request.urlopen(
-                    req, timeout=self._timeout, context=ctx) as r:
-                body = json.loads(r.read())
+            body = _open_health(self.health_url, self._timeout, ctx)
             idx = int(body.get("worker", -1))
         except Exception:
             return  # timeouts/refusals are absence, not evidence
@@ -165,18 +244,60 @@ class _LivenessProbe:
         self._stop.set()
 
 
-def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
+class _ReadyWaiter:
+    """Rapid-samples /health until worker `idx` answers at `epoch` or
+    newer — the rolling restart's 'replacement is actually serving'
+    gate. SO_REUSEPORT spreads samples across ALL listeners, so seeing
+    the right (index, epoch) pair is the only targeted signal there is."""
+
+    def __init__(self, health_url: str, idx: int, epoch: int,
+                 timeout_s: float):
+        self.event = threading.Event()
+        self._stop = threading.Event()
+        self._idx = idx
+        self._epoch = epoch
+        self._url = health_url
+        self._timeout = timeout_s
+        threading.Thread(target=self._loop, daemon=True,
+                         name="itpu-supervisor-rollwait").start()
+
+    def _loop(self) -> None:
+        ctx = _ssl_ctx_for(self._url)
+        while not self._stop.is_set():
+            try:
+                body = _open_health(self._url, self._timeout, ctx)
+                if int(body.get("worker", -1)) == self._idx \
+                        and int(body.get("epoch", 0)) >= self._epoch:
+                    self.event.set()
+                    return
+            except Exception:  # itpu: allow[ITPU004] boot poll: refusals are just "not ready yet"
+                pass
+            time.sleep(0.15)
+
+    def ready(self) -> bool:
+        return self.event.is_set()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+def run_supervisor(argv: list, workers: int, health_url: str = "",
+                   fleet=None, roll_grace_s: float = 5.0) -> int:
     """Spawn and babysit `workers` serving processes; returns an exit code.
 
     Lifecycle: SIGTERM/SIGINT here fans out to every worker (each drains
     in-flight requests, ref: server.go:144-165 semantics per process);
     the supervisor then waits for all of them. An unexpected worker death
     outside shutdown is respawned under the restart budget with
-    exponential backoff; with a `health_url`, a HUNG worker (alive but
-    unseen by the liveness probe past the window) is replaced
-    drain-aware: spawn the replacement, then SIGTERM -> grace -> SIGKILL
-    the hung one.
+    full-jitter exponential backoff; with a `health_url`, a HUNG worker
+    (alive but unseen by the liveness probe past the window) is replaced
+    drain-aware: stamp + spawn the replacement, then SIGTERM -> grace ->
+    SIGKILL the hung one. SIGHUP rolls the fleet one worker at a time
+    (see the module docstring for the protocol). `fleet` is the shared
+    cache (fleet/shmcache.ShmCache) whose epoch table fences deposed
+    workers; None when --fleet-cache-mb is off (epochs still ride env).
     """
+    check_reuseport()
     probe_interval = _env_f("IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL", 2.0)
     probe_timeout = _env_f("IMAGINARY_TPU_SUPERVISOR_PROBE_TIMEOUT", 2.0)
     # 0 disables hang detection (probing still runs for logs/ops)
@@ -185,37 +306,68 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
     boot_grace = _env_f("IMAGINARY_TPU_SUPERVISOR_BOOT_GRACE", 90.0)
     hang_grace = _env_f("IMAGINARY_TPU_SUPERVISOR_HANG_GRACE", 7.0)
     backoff_base = _env_f("IMAGINARY_TPU_SUPERVISOR_BACKOFF", 0.5)
+    restart_budget = int(_env_f("IMAGINARY_TPU_SUPERVISOR_RESTART_BUDGET",
+                                MAX_RESTARTS_PER_WORKER))
 
     procs: dict = {}
     spawn_t: dict = {}
+    epochs: dict = {}
     restarts = {i: [] for i in range(workers)}
     consec_restarts = {i: 0 for i in range(workers)}
     respawn_at: dict = {}  # idx -> monotonic time the backoff allows it
-    terminating: list = []  # (proc, sigkill_deadline) for hung workers
+    terminating: list = []  # (proc, sigkill_deadline) for draining workers
     stopping = False
+    roll_pending = False
+    roll_queue: list = []
+    roll = None  # the in-flight roll step's state dict
+    epoch_counter = 0
+
+    def next_epoch() -> int:
+        nonlocal epoch_counter
+        epoch_counter += 1
+        return epoch_counter
 
     def handle_stop(signum, frame):
         nonlocal stopping
         stopping = True
 
+    def handle_roll(signum, frame):
+        nonlocal roll_pending
+        roll_pending = True
+
     signal.signal(signal.SIGTERM, handle_stop)
     signal.signal(signal.SIGINT, handle_stop)
+    signal.signal(signal.SIGHUP, handle_roll)
+
+    probe = None
+
+    def spawn(i: int) -> None:
+        """Every (re)spawn: mint a fresh epoch, stamp the shm fence
+        table FIRST (the predecessor — crashed, hung, or rolling out —
+        is deposed from this instant), then exec the child."""
+        e = next_epoch()
+        epochs[i] = e
+        if fleet is not None:
+            fleet.stamp_epoch(i, e)
+        if probe is not None:
+            probe.forget(i)
+        procs[i] = _spawn(argv, i, epoch=e)
+        spawn_t[i] = time.monotonic()
 
     for i in range(workers):
-        procs[i] = _spawn(argv, i)
-        spawn_t[i] = time.monotonic()
+        spawn(i)
     print(f"imaginary-tpu supervisor: {workers} workers "
           f"(pids {[p.pid for p in procs.values()]})")
 
-    probe = None
     if health_url and liveness_timeout > 0:
         probe = _LivenessProbe(health_url, workers, probe_interval,
                                probe_timeout)
 
     def charge_restart(i: int, now: float) -> bool:
-        """Book one restart against worker i's budget; False = exhausted."""
+        """Book one restart against worker i's budget; False = exhausted.
+        Planned rolls never charge — the budget meters FAILURES."""
         restarts[i] = [t for t in restarts[i] if now - t < 3600.0]
-        if len(restarts[i]) >= MAX_RESTARTS_PER_WORKER:
+        if len(restarts[i]) >= restart_budget:
             return False
         restarts[i].append(now)
         # survived long enough since its last (re)spawn? the crash loop
@@ -224,6 +376,30 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
             consec_restarts[i] = 0
         consec_restarts[i] += 1
         return True
+
+    def abort_roll(reason: str) -> None:
+        """A replacement that never became ready must not take the old
+        worker down with it: keep the old serving (re-stamp its epoch so
+        it is unfenced again), discard the replacement, drop the roll."""
+        nonlocal roll, roll_queue
+        i = roll["idx"]
+        print(f"imaginary-tpu supervisor: roll of worker {i} aborted "
+              f"({reason}); old worker keeps serving", file=sys.stderr)
+        repl = procs[i]
+        if repl.poll() is None:
+            try:
+                repl.kill()
+            except ProcessLookupError:
+                pass
+        procs[i] = roll["old"]
+        epochs[i] = roll["old_epoch"]
+        spawn_t[i] = roll["old_spawn_t"]
+        if fleet is not None:
+            fleet.stamp_epoch(i, roll["old_epoch"])
+        if roll["waiter"] is not None:
+            roll["waiter"].close()
+        roll = None
+        roll_queue = []
 
     exit_code = 0
     stop_deadline = None
@@ -240,8 +416,12 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
             # the platform kills the whole cgroup.
             if stop_deadline is None:
                 stop_deadline = time.monotonic() + 15.0  # 5 s drain + margin
+                if roll is not None and roll["waiter"] is not None:
+                    roll["waiter"].close()
             alive = [p for p in procs.values() if p.poll() is None]
             alive += [p for p, _ in terminating if p.poll() is None]
+            if roll is not None and roll["old"].poll() is None:
+                alive.append(roll["old"])
             if not alive:
                 break
             hard = time.monotonic() > stop_deadline
@@ -253,8 +433,9 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
             time.sleep(0.1)
             continue
         now = time.monotonic()
-        # escalate hung workers being drained: SIGTERM was sent when the
-        # replacement spawned; past the grace the kernel takes over
+        # escalate draining workers: SIGTERM was sent when the
+        # replacement spawned (hang) or the roll grace expired; past the
+        # grace the kernel takes over
         for p, deadline in list(terminating):
             if p.poll() is not None:
                 terminating.remove((p, deadline))
@@ -263,6 +444,64 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
                     p.kill()
                 except ProcessLookupError:
                     pass
+        # -- rolling restart state machine (SIGHUP) -----------------------
+        if roll_pending:
+            roll_pending = False
+            if not roll_queue and roll is None:
+                roll_queue = list(range(workers))
+                print("imaginary-tpu supervisor: SIGHUP — rolling "
+                      f"{workers} workers (grace {roll_grace_s:.1f}s)",
+                      file=sys.stderr)
+        if roll is None and roll_queue:
+            i = roll_queue.pop(0)
+            old, old_epoch, old_spawn = procs[i], epochs[i], spawn_t[i]
+            spawn(i)  # stamps epoch+1: the old worker is deposed NOW
+            waiter = None
+            if health_url:
+                waiter = _ReadyWaiter(health_url, i, epochs[i],
+                                      probe_timeout)
+            roll = {"idx": i, "old": old, "old_epoch": old_epoch,
+                    "old_spawn_t": old_spawn, "phase": "wait_ready",
+                    "waiter": waiter, "deadline": now + boot_grace}
+            print(f"imaginary-tpu supervisor: rolling worker {i} "
+                  f"(epoch {old_epoch} -> {epochs[i]})", file=sys.stderr)
+        elif roll is not None and roll["phase"] == "wait_ready":
+            i = roll["idx"]
+            ready = roll["waiter"].ready() if roll["waiter"] is not None \
+                else now - spawn_t[i] > boot_grace
+            if procs[i].poll() is not None:
+                abort_roll(f"replacement exited {procs[i].poll()} before "
+                           "ready")
+            elif ready:
+                # replacement serves; old stops ACCEPTING (SIGUSR1
+                # closes its listener, SO_REUSEPORT routes new
+                # connections next door) but keeps finishing in-flight
+                # and keep-alive work through the grace
+                try:
+                    roll["old"].send_signal(signal.SIGUSR1)
+                except ProcessLookupError:
+                    pass
+                roll["phase"] = "grace"
+                roll["until"] = now + max(0.0, roll_grace_s)
+                if roll["waiter"] is not None:
+                    roll["waiter"].close()
+            elif now > roll["deadline"]:
+                abort_roll("replacement never reported ready within the "
+                           "boot grace")
+        elif roll is not None and roll["phase"] == "grace" \
+                and now >= roll["until"]:
+            # grace over: the old worker runs its normal shutdown drain
+            # (app["draining"] 503 + Retry-After for stragglers, 5 s
+            # in-flight completion), escalated like any hung drain
+            try:
+                roll["old"].send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            terminating.append((roll["old"], now + hang_grace + 6.0))
+            done_idx = roll["idx"]
+            roll = None
+            print(f"imaginary-tpu supervisor: worker {done_idx} rolled",
+                  file=sys.stderr)
         # Sweep deaths BEFORE any liveness break: if every worker dies
         # inside one interval (shared boot crash — bad mount, bad cert),
         # the respawn/budget logic must still run; breaking on "none
@@ -278,6 +517,8 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
                 # replace it drain-aware, then terminate it.
                 if probe is None:
                     continue
+                if roll is not None and roll["idx"] == i:
+                    continue  # the roll's ready gate owns this index now
                 seen = probe.seen_at(i)
                 ref = seen if seen is not None else spawn_t[i] + boot_grace
                 if now - ref < liveness_timeout:
@@ -291,20 +532,20 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
                     break
                 print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
                       f"unseen for {now - ref:.0f}s; presumed hung — "
-                      "spawning replacement, then SIGTERM",
+                      "fencing, spawning replacement, then SIGTERM",
                       file=sys.stderr)
                 # replacement FIRST: both bind via SO_REUSEPORT, so the
-                # port keeps a live listener while the old worker drains
-                probe.forget(i)
-                procs[i] = _spawn(argv, i)
-                spawn_t[i] = now
+                # port keeps a live listener while the old worker drains.
+                # spawn() stamps the fence table before the exec, so the
+                # hung worker — should it ever wake — is already deposed.
+                spawn(i)
                 try:
                     p.send_signal(signal.SIGTERM)
                 except ProcessLookupError:
                     pass
                 terminating.append((p, now + hang_grace))
                 continue
-            # exited: respawn under budget, after the backoff delay
+            # exited: respawn under budget, after the jittered backoff
             if i not in respawn_at:
                 if not charge_restart(i, now):
                     print(f"imaginary-tpu supervisor: worker {i} exceeded "
@@ -313,23 +554,22 @@ def run_supervisor(argv: list, workers: int, health_url: str = "") -> int:
                     exit_code = rc or 1
                     stopping = True
                     break
-                delay = min(30.0, backoff_base
-                            * (2.0 ** (consec_restarts[i] - 1)))
+                delay = _backoff_delay(backoff_base, consec_restarts[i])
                 respawn_at[i] = now + delay
                 print(f"imaginary-tpu supervisor: worker {i} (pid {p.pid}) "
                       f"exited {rc}; respawning in {delay:.1f}s",
                       file=sys.stderr)
             if now >= respawn_at[i]:
                 respawn_at.pop(i, None)
-                if probe is not None:
-                    probe.forget(i)
-                procs[i] = _spawn(argv, i)
-                spawn_t[i] = now
+                spawn(i)
         time.sleep(0.2)
 
     if probe is not None:
         probe.close()
-    for p in list(procs.values()) + [p for p, _ in terminating]:  # reap
+    reap = list(procs.values()) + [p for p, _ in terminating]
+    if roll is not None:
+        reap.append(roll["old"])
+    for p in reap:
         try:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:

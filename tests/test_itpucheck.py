@@ -290,6 +290,59 @@ class TestContextPropagation:
         assert findings == []
 
 
+class TestSlotProtocol:
+    def test_trips_acquire_without_finally_abandon(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Cache:\n"
+            "    def put(self, idx, body):\n"
+            "        slot = self._slot_acquire(idx)\n"
+            "        self._write(slot, body)\n"  # a raise leaks the lock
+            "        self._slot_publish(slot)\n"
+        )}, rules=["ITPU009"])
+        assert [f.line for f in findings] == [3]
+        assert _rules_hit(findings) == {"ITPU009"}
+
+    def test_trips_abandon_in_except_not_finally(self, tmp_path):
+        # an except-only abandon misses the success path's unlock AND
+        # non-Exception exits; the protocol demands a finally
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Cache:\n"
+            "    def put(self, idx, body):\n"
+            "        slot = self._slot_acquire(idx)\n"
+            "        try:\n"
+            "            self._slot_publish(slot)\n"
+            "        except Exception:\n"
+            "            self._slot_abandon(slot)\n"
+        )}, rules=["ITPU009"])
+        assert [f.line for f in findings] == [3]
+
+    def test_publish_then_abandon_in_finally_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Cache:\n"
+            "    def put(self, idx, body):\n"
+            "        slot = self._slot_acquire(idx)\n"
+            "        if slot is None:\n"
+            "            return False\n"
+            "        try:\n"
+            "            self._write(slot, body)\n"
+            "            self._slot_publish(slot)\n"
+            "            return True\n"
+            "        finally:\n"
+            "            self._slot_abandon(slot)\n"
+        )}, rules=["ITPU009"])
+        assert findings == []
+
+    def test_primitives_themselves_exempt(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Cache:\n"
+            "    def _slot_acquire(self, idx):\n"
+            "        return self._slot_acquire(idx - 1) if idx else None\n"
+            "    def _slot_abandon(self, slot):\n"
+            "        self._unlock(slot.idx)\n"
+        )}, rules=["ITPU009"])
+        assert findings == []
+
+
 # -- suppression grammar ------------------------------------------------------
 
 
@@ -363,8 +416,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 8 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 8
+        # all 9 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 9
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

@@ -53,21 +53,27 @@ def _sample_pids(port: int, n: int = 24) -> set:
 
 
 @pytest.fixture(scope="module")
-def fleet():
+def fleet(tmp_path_factory):
     from tests.conftest import free_port
     port = free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("IMAGINARY_TPU_WORKER", None)
+    env.pop("IMAGINARY_TPU_WORKER_EPOCH", None)
+    # a known shared-cache path so tests can assert fencing against the
+    # LIVE fleet's file; a short roll grace keeps the roll test fast
+    fleet_path = str(tmp_path_factory.mktemp("fleet") / "cache.shm")
+    env["IMAGINARY_TPU_FLEET_PATH"] = fleet_path
     sup = subprocess.Popen(
         [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
-         "--port", str(port)],
+         "--port", str(port), "--fleet-cache-mb", "8",
+         "--fleet-roll-grace", "1.0"],
         cwd=ROOT, env=env,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     try:
         _wait_healthy(port)
-        yield port, sup
+        yield port, sup, fleet_path
     finally:
         if sup.poll() is None:
             sup.send_signal(signal.SIGTERM)
@@ -79,7 +85,7 @@ def fleet():
 
 
 def test_two_workers_share_one_port(fleet):
-    port, _ = fleet
+    port, _, _ = fleet
     # let the second worker finish booting before sampling the pair
     end = time.monotonic() + 45
     pids = set()
@@ -91,7 +97,7 @@ def test_two_workers_share_one_port(fleet):
 
 
 def test_crashed_worker_is_respawned(fleet):
-    port, _ = fleet
+    port, _, _ = fleet
     victim = _health(port)["pid"]
     os.kill(victim, signal.SIGKILL)
     # the supervisor notices within its 200 ms sweep and respawns; the
@@ -108,7 +114,7 @@ def test_crashed_worker_is_respawned(fleet):
 
 
 def test_requests_served_during_and_after_respawn(fleet):
-    port, _ = fleet
+    port, _, _ = fleet
     from tests.conftest import fixture_bytes
 
     body = fixture_bytes("imaginary.jpg")
@@ -124,9 +130,108 @@ def test_requests_served_during_and_after_respawn(fleet):
     assert ok == 6
 
 
+def test_epochs_stamped_and_fleet_block_served(fleet):
+    port, _, fleet_path = fleet
+    # both worker indices carry supervisor-stamped epochs; with the
+    # shared cache armed every /health response carries the fleet block
+    seen = {}
+    end = time.monotonic() + 45
+    while time.monotonic() < end and len(seen) < 2:
+        try:
+            h = _health(port)
+            seen[h["worker"]] = h["epoch"]
+            assert "fleet" in h
+        except Exception:
+            time.sleep(0.2)
+    assert set(seen) == {0, 1}, seen
+    assert all(e > 0 for e in seen.values())
+    assert len(set(seen.values())) == 2  # epochs are fleet-unique
+    # the shm epoch table agrees with what the workers report
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    client = ShmCache(fleet_path, create=False)
+    try:
+        for idx, epoch in seen.items():
+            assert client.epoch_of(idx) >= epoch
+    finally:
+        client.close()
+
+
+@pytest.mark.slow
+def test_sighup_rolls_fleet_with_monotonic_epochs(fleet):
+    port, sup, fleet_path = fleet
+    from tests.conftest import fixture_bytes
+
+    body = fixture_bytes("imaginary.jpg")
+
+    def epochs_now(deadline_s=45):
+        got = {}
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end and len(got) < 2:
+            try:
+                h = _health(port)
+                got[h["worker"]] = max(got.get(h["worker"], 0), h["epoch"])
+            except Exception:
+                time.sleep(0.2)
+        return got
+
+    before = epochs_now()
+    assert set(before) == {0, 1}
+    sup.send_signal(signal.SIGHUP)
+    # the roll replaces both workers one at a time; service must answer
+    # throughout (each replacement pays a fresh jax boot, so be patient)
+    observed = {0: [before[0]], 1: [before[1]]}
+    end = time.monotonic() + 240
+    rolled = False
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/resize?width=48", data=body,
+        headers={"Content-Type": "image/jpeg", "Connection": "close"},
+    )
+    while time.monotonic() < end:
+        try:
+            h = _health(port)
+            observed[h["worker"]].append(h["epoch"])
+        except Exception:
+            pass
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+        except (urllib.error.HTTPError, OSError):
+            pass  # noqa: PERF203 - a straggler 503 during drain is the documented contract
+        cur = {i: max(v) for i, v in observed.items()}
+        if cur[0] > before[0] and cur[1] > before[1]:
+            rolled = True
+            break
+        time.sleep(0.3)
+    assert rolled, f"roll never completed: {observed}"
+    # Epoch discipline per index: during a handover BOTH the old and the
+    # new holder serve (that is the zero-downtime design), so samples may
+    # interleave the two epochs — but nothing outside {old, new} may ever
+    # appear, and the new epoch is strictly greater.
+    for idx, seq in observed.items():
+        new = max(seq)
+        assert new > before[idx]
+        assert set(seq) <= {before[idx], new}, \
+            f"worker {idx} showed an off-the-books epoch: {seq}"
+    # fencing: the deposed epochs can no longer publish to the shared
+    # cache (the SIGSTOP zombie protocol, asserted against the live file)
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    zombie = ShmCache(fleet_path, create=False, worker=0, epoch=before[0])
+    try:
+        assert zombie.fenced()
+        assert not zombie.put(b"z" * 32, b"m", b"b")
+        assert zombie.stats.fenced_publishes == 1
+    finally:
+        zombie.close()
+    # and the fleet still serves normally after the roll
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.status == 200
+
+
 def test_sigterm_drains_whole_fleet(fleet):
     # runs LAST in-module: tears the shared fleet down for real
-    port, sup = fleet
+    port, sup, _ = fleet
     worker_pids = set()
     end = time.monotonic() + 30
     while time.monotonic() < end and len(worker_pids) < 2:
@@ -148,3 +253,205 @@ def test_worker_index_helper():
         assert worker_index() == 3
     finally:
         del os.environ[WORKER_ENV]
+
+
+@pytest.mark.slow
+def test_serving_process_ignores_sighup(tmp_path):
+    """SIGHUP often lands on the whole process GROUP (terminal hangup,
+    init systems, signal-forwarding wrappers). Only the supervisor may
+    treat it as a roll trigger; a serving process must keep serving —
+    the default disposition would turn 'roll the fleet' into 'kill
+    every worker at once' (caught live: a forwarded SIGHUP dropped
+    requests until this pin)."""
+    from tests.conftest import fixture_bytes, free_port
+
+    port = free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("IMAGINARY_TPU_WORKER", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "imaginary_tpu.cli", "--port", str(port)],
+        cwd=ROOT, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_healthy(port)
+        proc.send_signal(signal.SIGHUP)
+        time.sleep(1.0)
+        assert proc.poll() is None, "serving process died on SIGHUP"
+        body = fixture_bytes("imaginary.jpg")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/resize?width=64", data=body,
+            headers={"Content-Type": "image/jpeg", "Connection": "close"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+@pytest.mark.slow
+def test_hung_worker_replacement_is_drain_aware(tmp_path):
+    """Drain-aware replacement ordering for a hung (SIGSTOPped) worker:
+    the supervisor stamps the fence and spawns the replacement BEFORE it
+    starts tearing the hung worker down — observable as the shm epoch
+    table advancing while the hung process is still alive (teardown of a
+    stopped process is SIGKILL after the hang grace; a supervisor that
+    killed first would show the bump only after the pid vanished). The
+    replacement must then actually serve, and the zombie must die."""
+    from tests.conftest import free_port
+
+    port = free_port()
+    fleet_path = str(tmp_path / "fence.shm")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("IMAGINARY_TPU_WORKER", None)
+    env.pop("IMAGINARY_TPU_WORKER_EPOCH", None)
+    env["IMAGINARY_TPU_FLEET_PATH"] = fleet_path
+    env.update({
+        "IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL": "0.3",
+        "IMAGINARY_TPU_SUPERVISOR_PROBE_TIMEOUT": "1.0",
+        "IMAGINARY_TPU_SUPERVISOR_LIVENESS_TIMEOUT": "3.0",
+        "IMAGINARY_TPU_SUPERVISOR_HANG_GRACE": "2.0",
+        "IMAGINARY_TPU_SUPERVISOR_BOOT_GRACE": "20.0",
+    })
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
+         "--port", str(port), "--fleet-cache-mb", "4"],
+        cwd=ROOT, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_healthy(port)
+        seen = {}
+        end = time.monotonic() + 45
+        while time.monotonic() < end and len(seen) < 2:
+            try:
+                h = _health(port)
+                seen[h["worker"]] = (h["pid"], h["epoch"])
+            except Exception:
+                time.sleep(0.2)
+        assert set(seen) == {0, 1}
+        time.sleep(2.0)  # let the SUPERVISOR's probe sight both workers
+        zpid, zepoch = seen[1]
+        from imaginary_tpu.fleet.shmcache import ShmCache
+
+        client = ShmCache(fleet_path, create=False, worker=1, epoch=zepoch)
+        try:
+            os.kill(zpid, signal.SIGSTOP)
+            # the fence/spawn must land while the hung pid still exists
+            fenced_while_hung_alive = False
+            end = time.monotonic() + 60
+            while time.monotonic() < end:
+                bumped = client.epoch_of(1) > zepoch
+                try:
+                    os.kill(zpid, 0)
+                except ProcessLookupError:
+                    # pid gone: only acceptable if the bump came first
+                    assert fenced_while_hung_alive, \
+                        "hung worker torn down before fence+replacement"
+                    break
+                if bumped:
+                    fenced_while_hung_alive = True
+                    break
+                time.sleep(0.05)
+            assert fenced_while_hung_alive
+            assert client.fenced()
+            new_epoch = client.epoch_of(1)
+            assert new_epoch > zepoch
+        finally:
+            client.close()
+        # the replacement must come up serving at the stamped epoch
+        end = time.monotonic() + 60
+        replacement_serving = False
+        while time.monotonic() < end:
+            try:
+                h = _health(port)
+                if h["worker"] == 1 and h["pid"] != zpid \
+                        and h["epoch"] == new_epoch:
+                    replacement_serving = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert replacement_serving, "replacement never served"
+        # release the zombie into the queued SIGTERM; the supervisor's
+        # SIGKILL escalation may already have reaped it (SIGKILL acts on
+        # stopped processes) — either way it must END UP dead
+        try:
+            os.kill(zpid, signal.SIGCONT)
+        except ProcessLookupError:
+            pass  # already SIGKILLed past the hang grace: teardown done
+        end = time.monotonic() + 30
+        while time.monotonic() < end:
+            try:
+                os.kill(zpid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("revived zombie never exited")
+    finally:
+        if sup.poll() is None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                sup.wait()
+
+
+# --- supervisor paths that need no jax boot ----------------------------------
+
+
+def test_backoff_uses_full_jitter(monkeypatch):
+    from imaginary_tpu.web import workers
+
+    calls = []
+
+    def fake_uniform(lo, hi):
+        calls.append((lo, hi))
+        return hi
+
+    monkeypatch.setattr(workers.random, "uniform", fake_uniform)
+    assert workers._backoff_delay(0.5, 1) == 0.5
+    assert workers._backoff_delay(0.5, 3) == 2.0
+    assert workers._backoff_delay(0.5, 30) == 30.0  # capped
+    # every delay is drawn uniform over [0, cap] — full jitter, so a
+    # correlated fleet death respawns decorrelated
+    assert calls == [(0.0, 0.5), (0.0, 2.0), (0.0, 30.0)]
+
+
+def test_reuseport_guard_refuses_without_support(monkeypatch):
+    import socket as socket_mod
+
+    from imaginary_tpu.web.workers import check_reuseport
+
+    check_reuseport()  # this host has it (the fleet fixture relies on it)
+    monkeypatch.delattr(socket_mod, "SO_REUSEPORT")
+    with pytest.raises(SystemExit, match="SO_REUSEPORT"):
+        check_reuseport()
+
+
+def test_restart_budget_exhaustion_shuts_the_fleet_down(monkeypatch):
+    """A worker argv that dies instantly (argparse rejects the flag
+    before any jax import) must burn its respawn budget and stop the
+    supervisor with a nonzero exit — not spin forever."""
+    from imaginary_tpu.web.workers import run_supervisor
+
+    monkeypatch.setenv("IMAGINARY_TPU_SUPERVISOR_RESTART_BUDGET", "2")
+    monkeypatch.setenv("IMAGINARY_TPU_SUPERVISOR_BACKOFF", "0.05")
+    monkeypatch.delenv("IMAGINARY_TPU_WORKER", raising=False)
+    saved = {s: signal.getsignal(s)
+             for s in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP)}
+    t0 = time.monotonic()
+    try:
+        rc = run_supervisor(["--no-such-flag"], workers=1)
+    finally:
+        for s, h in saved.items():
+            signal.signal(s, h)
+    assert rc != 0
+    assert time.monotonic() - t0 < 60.0  # budget ended it, not a timeout
