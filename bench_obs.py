@@ -12,11 +12,23 @@ behind cache hits. Two arms on the same host:
     surfaces suppressed; metrics histograms — an always-on /metrics
     surface, like TIMES — keep recording in both arms)
 
-Prints one JSON line on stdout; human detail on stderr. Exits nonzero
-when the ON arm lost more than BENCH_OBS_MAX_OVERHEAD_PCT (default 10 —
-a gross-regression gate tolerant of short-run noise; the acceptance
-criterion is <= 2% on a full-length run) or when tracing surfaces are
-missing from responses.
+A second row exercises the fleet observability plane end to end: a real
+2-worker supervisor subprocess with --wide-events-sample 0.02 and
+--fleet-admin-port, driven with boring traffic plus deliberate faults
+(garbage bodies -> 400) while the supervisor-aggregated /metrics is
+scraped under load. Gates: tail sampling keeps 100% of fault events
+while total wide-event volume drops >= 10x vs requests served, and
+scraping the admin plane moves request p50 by no more than
+BENCH_OBS_FLEET_MAX_OVERHEAD_PCT (default 25 — p50 deltas on 1-2s
+slices are noisy; the criterion is "within noise", not a tight budget).
+The fleet row is archived to artifacts/bench_obs_fleet.jsonl.
+
+Prints one JSON line per row on stdout; human detail on stderr. Exits
+nonzero when the tracing ON arm lost more than
+BENCH_OBS_MAX_OVERHEAD_PCT (default 10 — a gross-regression gate
+tolerant of short-run noise; the acceptance criterion is <= 2% on a
+full-length run), when tracing surfaces are missing from responses, or
+when any fleet-row gate breaches.
 """
 
 from __future__ import annotations
@@ -83,6 +95,230 @@ async def _arm(options, variants, duration: float, concurrency: int,
         await origin_runner.cleanup()
 
 
+_FLEET_SAMPLE = 0.02     # firehose cut the fleet row is graded on
+_FAULT_EVERY = 25        # every Nth request posts a garbage body (-> 400)
+
+
+def _fleet_row(duration: float, concurrency: int, jpeg: bytes) -> int:
+    """2-worker fleet arm: tail-sampling retention/volume + scrape overhead."""
+    import signal
+    import subprocess
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from bench_util import free_port
+    from imaginary_tpu.obs.aggregate import parse_exposition
+
+    port, admin_port = free_port(), free_port()
+    fleet_max = float(os.environ.get("BENCH_OBS_FLEET_MAX_OVERHEAD_PCT", "25"))
+    env = dict(os.environ, PYTHONUNBUFFERED="1",
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "imaginary_tpu.cli",
+         "--workers", "2", "--port", str(port),
+         "--wide-events", "--wide-events-sample", str(_FLEET_SAMPLE),
+         "--fleet-admin-port", str(admin_port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+    # drain the supervisor's pipe from a thread: workers inherit this fd for
+    # wide events + access log, and an undrained 64KB pipe deadlocks the fleet
+    event_lines: list = []
+    def _reader():
+        for raw in proc.stdout:
+            line = raw.decode("utf-8", "replace").strip()
+            if line.startswith("{"):
+                event_lines.append(line)
+    reader = threading.Thread(target=_reader, daemon=True)
+    reader.start()
+
+    def _get(url, timeout=15.0):
+        req = urllib.request.Request(url, headers={"Connection": "close"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+
+    url = f"http://127.0.0.1:{port}/resize?width=64"
+    lock = threading.Lock()
+    state = {"n": 0, "faults_acked": 0, "client_errors": 0}
+
+    def _traffic(dur: float):
+        lats: list = []
+        stop = time.monotonic() + dur
+
+        def w():
+            while time.monotonic() < stop:
+                with lock:
+                    state["n"] += 1
+                    fault = state["n"] % _FAULT_EVERY == 0
+                body = b"deliberately-not-a-jpeg" if fault else jpeg
+                req = urllib.request.Request(
+                    url, data=body, headers={"Connection": "close"})
+                t0 = time.monotonic()
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                        status = r.status
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    status = e.code
+                except Exception:
+                    with lock:
+                        state["client_errors"] += 1
+                    continue
+                dt = (time.monotonic() - t0) * 1000.0
+                with lock:
+                    if fault:
+                        if status >= 400:
+                            state["faults_acked"] += 1
+                    elif status == 200:
+                        lats.append(dt)
+
+        threads = [threading.Thread(target=w) for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats
+
+    scrape = {"count": 0, "lats": [], "last": ""}
+
+    def _scraper(stop_evt: threading.Event):
+        # paced at ~4/s — far hotter than any real scrape interval, without
+        # degenerating into back-to-back aggregation (each scrape fans out
+        # repeated worker fetches, so a zero-gap loop measures a DoS, not a
+        # scraper)
+        while not stop_evt.is_set():
+            t0 = time.monotonic()
+            try:
+                _, body = _get(
+                    f"http://127.0.0.1:{admin_port}/metrics", timeout=20)
+                scrape["last"] = body.decode()
+                scrape["lats"].append((time.monotonic() - t0) * 1000.0)
+                scrape["count"] += 1
+            except Exception:
+                pass
+            stop_evt.wait(0.25)
+
+    try:
+        # boot: both workers serving (distinct pids) before anything is timed
+        deadline = time.monotonic() + 180
+        pids: set = set()
+        while time.monotonic() < deadline and len(pids) < 2:
+            try:
+                _, body = _get(f"http://127.0.0.1:{port}/health", timeout=5)
+                pids.add(json.loads(body).get("pid"))
+            except Exception:
+                time.sleep(0.5)
+        if len(pids) < 2:
+            print("[obs-bench] FAIL: fleet never reached 2 serving workers",
+                  file=sys.stderr)
+            return 1
+        _traffic(1.0)  # warmup: XLA compiles on both workers, untimed
+
+        slice_s = max(duration / 2.0, 1.0)
+        lats_quiet: list = []
+        lats_scraped: list = []
+        for arm_scrape in (False, True, True, False):  # ABBA, as above
+            if arm_scrape:
+                stop_evt = threading.Event()
+                st = threading.Thread(target=_scraper, args=(stop_evt,))
+                st.start()
+                lats_scraped.extend(_traffic(slice_s))
+                stop_evt.set()
+                st.join(timeout=30)
+            else:
+                lats_quiet.extend(_traffic(slice_s))
+
+        # fleet-wide request total from the aggregated plane itself: the
+        # denominator for the volume-cut gate, taken before teardown
+        _, body = _get(f"http://127.0.0.1:{admin_port}/metrics", timeout=20)
+        fams = parse_exposition(body.decode())
+        req_fam = fams.get("imaginary_tpu_requests_total")
+        requests_total = sum(req_fam.samples.values()) if req_fam else 0.0
+
+        time.sleep(1.0)  # let the last events cross the pipe
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+    reader.join(timeout=15)
+
+    events = []
+    for line in event_lines:
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            pass
+    fault_events = [e for e in events
+                    if e.get("sampled_reason") == "error"
+                    and int(e.get("status", 0)) >= 400]
+    stamped = sum(1 for e in events
+                  if "worker" in e and "epoch" in e and "sampled_reason" in e)
+    volume_cut = (requests_total / len(events)) if events else 0.0
+    p50_quiet, p50_scraped = pctl(lats_quiet, 0.50), pctl(lats_scraped, 0.50)
+    scrape_overhead = (100.0 * (p50_scraped - p50_quiet) / p50_quiet) \
+        if p50_quiet else 0.0
+
+    row = {
+        "metric": "obs_fleet_tail_sampling",
+        "sample": _FLEET_SAMPLE,
+        "requests_total": round(requests_total, 0),
+        "events_total": len(events),
+        "events_fault": len(fault_events),
+        "faults_injected": state["faults_acked"],
+        "volume_cut_x": round(volume_cut, 1),
+        "scrapes": scrape["count"],
+        "scrape_p50_ms": pctl(scrape["lats"], 0.50),
+        "p50_ms": p50_scraped,
+        "p50_ms_no_scrape": p50_quiet,
+        "scrape_overhead_pct": round(scrape_overhead, 2),
+        "client_errors": state["client_errors"],
+    }
+    print(json.dumps(row))
+    os.makedirs("artifacts", exist_ok=True)
+    with open(os.path.join("artifacts", "bench_obs_fleet.jsonl"), "a") as f:
+        f.write(json.dumps(dict(row, ts=round(time.time(), 3))) + "\n")
+
+    ok = True
+    if state["faults_acked"] == 0 or not events:
+        print("[obs-bench] FAIL: fleet row produced no faults or no events "
+              f"(faults={state['faults_acked']}, events={len(events)})",
+              file=sys.stderr)
+        ok = False
+    if len(fault_events) < state["faults_acked"]:
+        print(f"[obs-bench] FAIL: tail sampling dropped fault events "
+              f"({len(fault_events)}/{state['faults_acked']} retained)",
+              file=sys.stderr)
+        ok = False
+    if stamped != len(events):
+        print(f"[obs-bench] FAIL: {len(events) - stamped} events missing "
+              "worker/epoch/sampled_reason stamps", file=sys.stderr)
+        ok = False
+    if volume_cut < 10.0:
+        print(f"[obs-bench] FAIL: event volume only cut {volume_cut:.1f}x "
+              f"(gate >= 10x; {len(events)} events for "
+              f"{requests_total:.0f} requests)", file=sys.stderr)
+        ok = False
+    if scrape["count"] == 0 or not scrape["last"]:
+        print("[obs-bench] FAIL: admin /metrics never scraped under load",
+              file=sys.stderr)
+        ok = False
+    if scrape_overhead > fleet_max:
+        print(f"[obs-bench] FAIL: scrape-under-load p50 overhead "
+              f"{scrape_overhead:.1f}% exceeds {fleet_max:.1f}% gate",
+              file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"[obs-bench] fleet row: {len(fault_events)}/"
+              f"{state['faults_acked']} fault events retained, volume cut "
+              f"{volume_cut:.1f}x, scrape overhead {scrape_overhead:.1f}% "
+              f"over {scrape['count']} scrapes", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main() -> int:
     from imaginary_tpu.web.config import ServerOptions
 
@@ -127,13 +363,20 @@ def main() -> int:
     }
     print(json.dumps(row))
 
+    rc = 0
     if overhead_pct > max_overhead:
         print(f"[obs-bench] FAIL: tracing overhead {overhead_pct:.1f}% "
               f"exceeds {max_overhead:.1f}% gate", file=sys.stderr)
-        return 1
-    print(f"[obs-bench] tracing overhead {overhead_pct:.1f}% "
-          f"({rps_off:.1f} -> {rps_on:.1f} req/s)", file=sys.stderr)
-    return 0
+        rc = 1
+    else:
+        print(f"[obs-bench] tracing overhead {overhead_pct:.1f}% "
+              f"({rps_off:.1f} -> {rps_on:.1f} req/s)", file=sys.stderr)
+
+    print(f"[obs-bench] fleet row: 2 workers, sample={_FLEET_SAMPLE}, "
+          f"fault every {_FAULT_EVERY}th request, admin scrape under load",
+          file=sys.stderr)
+    fleet_rc = _fleet_row(duration, concurrency, base_jpeg)
+    return rc or fleet_rc
 
 
 if __name__ == "__main__":

@@ -368,7 +368,14 @@ class CacheSet:
             return
         meta = (out.mime + "\n" + (placement or "")).encode("utf-8")
         try:
-            self.shm.put(shared_key(key), meta, out.body)
+            if not self.shm.put(shared_key(key), meta, out.body) \
+                    and self.shm.fenced():
+                # a deposed worker still serving: stamp the trace so the
+                # wide event is tail-kept ("fenced" — obs/events.classify)
+                # and the zombie window is attributable per request
+                tr = obs_trace.current()
+                if tr is not None:
+                    tr.annotate(fenced_publish=True)
         except Exception:
             # deliberate swallow: the deposit is advisory — the response
             # was already produced and must ship regardless (an injected

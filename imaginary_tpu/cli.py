@@ -337,6 +337,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "replacement reports ready and it stops "
                         "accepting, before SIGTERM starts its normal "
                         "shutdown drain")
+    p.add_argument("--fleet-admin-port", type=int,
+                   default=_env_int("IMAGINARY_TPU_FLEET_ADMIN_PORT", 0),
+                   help="supervisor admin plane on 127.0.0.1: /metrics "
+                        "(fleet-merged strict exposition with monotonic "
+                        "counter-reset correction across respawns) and "
+                        "/fleetz (per-worker epoch/restarts/liveness + "
+                        "health side by side); 0 disables (parity); "
+                        "meaningful only with --workers > 1")
     p.add_argument("--read-timeout", type=float,
                    default=_env_float("IMAGINARY_TPU_READ_TIMEOUT", 0.0),
                    help="close a connection whose request read (headers "
@@ -454,6 +462,20 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env_bool("IMAGINARY_TPU_WIDE_EVENTS"),
                    help="emit one structured JSON line per request "
                         "(op, plan digest, cache outcome, placement, spans)")
+    p.add_argument("--wide-events-sample", type=float,
+                   default=_env_float("IMAGINARY_TPU_WIDE_EVENTS_SAMPLE", 1.0),
+                   help="tail-based sampling probability for BORING wide "
+                        "events; errors/sheds/504s/hedges/placement "
+                        "trouble/fenced publishes/slow requests are always "
+                        "emitted regardless; 1.0 (default) keeps everything")
+    p.add_argument("--slo-config",
+                   default=os.environ.get("IMAGINARY_TPU_SLO_CONFIG", ""),
+                   help="per-route SLO objectives: inline JSON (starts "
+                        "with '{') or a file path mapping route -> "
+                        "{latency_ms, latency_target, availability} with "
+                        "'*' as catch-all; burn rates over 5m/1h windows "
+                        "surface in /health, /metrics and /debugz; empty "
+                        "disables (parity)")
     p.add_argument("--enable-debug", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_ENABLE_DEBUG")
                    or _env_bool("IMAGINARY_TPU_DEBUG"),
@@ -516,6 +538,15 @@ def options_from_args(args) -> ServerOptions:
             load_policy(args.qos_config)
         except ValueError as e:
             raise SystemExit(str(e)) from None
+    if args.slo_config:
+        # same boot-time discipline as --qos-config: a typo'd objective
+        # table must refuse to start, not silently track nothing
+        from imaginary_tpu.obs.slo import load_config as load_slo_config
+
+        try:
+            load_slo_config(args.slo_config)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
 
     return ServerOptions(
         port=port,
@@ -553,6 +584,7 @@ def options_from_args(args) -> ServerOptions:
         workers=_resolve_workers(args.workers),
         fleet_cache_mb=max(0.0, args.fleet_cache_mb),
         fleet_roll_grace_s=max(0.0, args.fleet_roll_grace),
+        fleet_admin_port=max(0, args.fleet_admin_port),
         read_timeout_s=max(0.0, args.read_timeout),
         max_queue_ms=max(0.0, args.max_queue_ms),
         request_timeout_s=max(0.0, args.request_timeout),
@@ -597,6 +629,8 @@ def options_from_args(args) -> ServerOptions:
         cache_source_mb=max(0.0, args.cache_source_mb),
         trace_enabled=not args.disable_tracing,
         wide_events=args.wide_events,
+        wide_events_sample=min(1.0, max(0.0, args.wide_events_sample)),
+        slo_config=args.slo_config,
         enable_debug=args.enable_debug,
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,
@@ -645,7 +679,8 @@ def main(argv=None) -> int:
             return run_supervisor(
                 list(argv) if argv is not None else sys.argv[1:],
                 o.workers, health_url=health_url, fleet=fleet,
-                roll_grace_s=o.fleet_roll_grace_s)
+                roll_grace_s=o.fleet_roll_grace_s,
+                admin_port=o.fleet_admin_port)
         finally:
             if fleet is not None:
                 fleet.close()

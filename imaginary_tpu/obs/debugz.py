@@ -118,6 +118,13 @@ def debug_payload(service) -> dict:
             # depths (imaginary_tpu/qos/tenancy.py QosPolicy.snapshot);
             # api keys appear as COUNTS only
             payload["qos"] = qos.snapshot()
+        slo = getattr(service, "slo", None)
+        if slo is not None:
+            # burn rates per route/window (obs/slo.py) — the same dict
+            # /health serves, so the two surfaces cannot drift. Absent
+            # with --slo-config unset: the block's presence IS the
+            # armed/parity signal.
+            payload["slo"] = slo.snapshot()
     return payload
 
 

@@ -33,13 +33,82 @@ Schema (stable field names — tests/test_obs.py pins them):
   tenant        resolved qos tenant name (only with --qos-config)
   qos_class     interactive | standard | batch (only with --qos-config)
   spans         [{name, start_ms, dur_ms}] full timeline
+  worker/epoch  serving process index + fencing generation — merged
+                streams from N workers are attributable, and the LB
+                retry contract (PR 11) correlates a retried request's
+                two attempts by shared X-Request-ID across workers
+  sampled_reason  why this event survived tail sampling (one of
+                SAMPLED_REASONS below); also stamped on slow-ring
+                entries so /debugz views are self-explaining
+
+Tail sampling (--wide-events-sample): the interesting tail — errors,
+sheds, deadline 504s, hedges, placement-ladder trouble, fenced
+publishes, slow-ring-worthy requests — is ALWAYS emitted; the boring
+rest rolls a probabilistic die. At the default sample=1.0 every boring
+event is kept ("random"), which is byte-for-byte the legacy emit-
+everything behavior minus the new stamp fields.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import sys
 import time
+
+# ITPU010 registry: every sampled_reason literal classify() can return
+# (and any literal compared against event["sampled_reason"] elsewhere)
+# must be declared here — tools/rules/obs_registry.py cross-checks.
+SAMPLED_REASONS = (
+    "error",       # status >= 400 (excluding the shed/deadline specials)
+    "shed",        # 503: admission/qos/pressure shed
+    "deadline",    # 504: request deadline exceeded
+    "hedged",      # a host hedge twin launched (won or lost)
+    "placement",   # placement ladder hit an error/quarantined/shed rung
+    "fenced",      # the request touched a fenced shm publish
+    "slow",        # duration >= SLOW_KEEP_MS (slow-ring-worthy)
+    "random",      # boring, but won the probabilistic roll
+    "unsampled",   # boring, lost the roll — classified but NOT emitted
+)
+
+# A request this slow is always kept: matches the operator instinct
+# ("anything over a second is a story") and guarantees the slow ring
+# and the event stream agree on what the tail looks like.
+SLOW_KEEP_MS = 1000.0
+
+
+def classify(event: dict, sample: float = 1.0, roll=None) -> str:
+    """Tail-sampling verdict for a finished request event.
+
+    Precedence: the most actionable signal wins, so a shed 503 reads
+    "shed" not "error" and a slow hedge reads "hedged" not "slow".
+    ``roll`` is injectable for tests (defaults to random.random).
+    """
+    status = event.get("status", 0)
+    if status == 503:
+        return "shed"
+    if status == 504:
+        return "deadline"
+    if isinstance(status, int) and status >= 400:
+        return "error"
+    if event.get("hedge"):
+        return "hedged"
+    attempts = event.get("placement_attempts") or ()
+    if any(
+        ("error" in a) or ("quarantined" in a) or ("shed" in a)
+        for a in attempts
+        if isinstance(a, str)
+    ):
+        return "placement"
+    if event.get("fenced_publish"):
+        return "fenced"
+    if float(event.get("duration_ms") or 0.0) >= SLOW_KEEP_MS:
+        return "slow"
+    if sample >= 1.0:
+        return "random"
+    if sample > 0.0 and (roll or random.random)() < sample:
+        return "random"
+    return "unsampled"
 
 
 def emit(event: dict, out=None) -> None:

@@ -52,21 +52,39 @@ def format_value(v) -> str:
 
 
 class Histogram:
-    """Thread-safe fixed-bucket cumulative histogram."""
+    """Thread-safe fixed-bucket cumulative histogram.
+
+    Buckets optionally carry OpenMetrics-style *exemplars*: the last
+    (request_id, trace_id, value) that landed in each bucket, so a
+    latency spike visible in the merged fleet view links straight to
+    the wide event / trace for one concrete slow request. Storage is
+    O(buckets) — one slot per bucket, last-writer-wins — and rendering
+    them is opt-in (/metrics?exemplars=1) because the strict 0.0.4
+    text-format parser (tests/test_obs.py) rejects the trailing
+    ``# {...}`` clause by design.
+    """
 
     def __init__(self, buckets=DEFAULT_BUCKETS):
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
+        self._exemplars: dict = {}  # bucket idx -> (rid, tid, value)
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar=None) -> None:
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                rid, tid = exemplar
+                self._exemplars[idx] = (rid, tid, value)
+
+    def exemplars(self) -> dict:
+        with self._lock:
+            return dict(self._exemplars)
 
     def snapshot(self):
         """(cumulative_counts aligned to buckets + [+Inf], sum, count)."""
@@ -138,8 +156,8 @@ class HistogramVec(_LabeledFamily):
     def __init__(self, label_names, buckets=DEFAULT_BUCKETS):
         super().__init__(label_names, lambda: Histogram(buckets))
 
-    def observe(self, label_values, value: float) -> None:
-        self.labels(*label_values).observe(value)
+    def observe(self, label_values, value: float, exemplar=None) -> None:
+        self.labels(*label_values).observe(value, exemplar=exemplar)
 
 
 class CounterVec(_LabeledFamily):
@@ -175,7 +193,7 @@ class Registry:
     def counter_vec(self, name, help_text, label_names):
         return self._add(name, help_text, CounterVec(label_names))
 
-    def render_lines(self) -> list:
+    def render_lines(self, exemplars: bool = False) -> list:
         lines: list = []
         with self._lock:
             families = list(self._families)
@@ -183,7 +201,7 @@ class Registry:
             if isinstance(metric, Histogram):
                 lines.append(f"# HELP {name} {help_text}")
                 lines.append(f"# TYPE {name} histogram")
-                _render_histogram(lines, name, "", metric)
+                _render_histogram(lines, name, "", metric, exemplars)
             elif isinstance(metric, HistogramVec):
                 lines.append(f"# HELP {name} {help_text}")
                 lines.append(f"# TYPE {name} histogram")
@@ -191,6 +209,7 @@ class Registry:
                     _render_histogram(
                         lines, name,
                         _label_str(metric.label_names, values), child,
+                        exemplars,
                     )
             elif isinstance(metric, Counter):
                 lines.append(f"# HELP {name} {help_text}")
@@ -205,13 +224,31 @@ class Registry:
         return lines
 
 
-def _render_histogram(lines, name, labels, hist: Histogram) -> None:
+def _exemplar_suffix(ex) -> str:
+    """OpenMetrics exemplar clause: ` # {labels} value` appended to a
+    bucket sample line (only when /metrics?exemplars=1 asks)."""
+    rid, tid, value = ex
+    return (
+        f' # {{trace_id="{escape_label_value(tid)}"'
+        f',request_id="{escape_label_value(rid)}"}} '
+        f"{repr(float(value))}"
+    )
+
+
+def _render_histogram(lines, name, labels, hist: Histogram,
+                      exemplars: bool = False) -> None:
     cumulative, total_sum, total_count = hist.snapshot()
-    for le, c in zip(hist.buckets, cumulative):
+    ex = hist.exemplars() if exemplars else {}
+    for idx, (le, c) in enumerate(zip(hist.buckets, cumulative)):
         sep = "," if labels else ""
-        lines.append(f'{name}_bucket{{{labels}{sep}le="{format_value(le)}"}} {c}')
+        tail = _exemplar_suffix(ex[idx]) if idx in ex else ""
+        lines.append(
+            f'{name}_bucket{{{labels}{sep}le="{format_value(le)}"}} {c}{tail}'
+        )
     sep = "," if labels else ""
-    lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {total_count}')
+    inf_idx = len(hist.buckets)
+    tail = _exemplar_suffix(ex[inf_idx]) if inf_idx in ex else ""
+    lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {total_count}{tail}')
     suffix = f"{{{labels}}}" if labels else ""
     lines.append(f"{name}_sum{suffix} {round(total_sum, 9)}")
     lines.append(f"{name}_count{suffix} {total_count}")

@@ -149,6 +149,13 @@ class RequestTrace:
         fetch is its own child of this request's span)."""
         return f"00-{self.trace_id}-{secrets.token_hex(8)}-{self.flags}"
 
+    def exemplar(self) -> tuple:
+        """(request_id, trace_id) — the identity pair the latency
+        histograms attach to their buckets (obs/histogram.py), so a
+        spike in the merged fleet exposition links to this request's
+        wide event."""
+        return self.request_id, self.trace_id
+
     # -- surfaces ----------------------------------------------------------
 
     def server_timing(self, limit: int = 16) -> str:

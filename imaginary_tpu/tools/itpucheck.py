@@ -34,6 +34,9 @@ Rules (one thin module per rule under tools/rules/):
   ITPU009  shm slot acquire without publish-or-abandon in a `finally`
            (locked-WRITING-slot leak class, the fleet-cache analogue of
            the ITPU003 ledger rule)
+  ITPU010  sampled_reason literals and imaginary_tpu_slo_* metric names
+           <-> their declared registries (SAMPLED_REASONS in
+           obs/events.py, SLO_METRICS in obs/slo.py)
 
 Suppression grammar (same-line, or a standalone comment covering the
 next code line); the reason is REQUIRED — a blanket suppression is

@@ -14,6 +14,7 @@ from imaginary_tpu.tools.rules import (
     future_guard,
     ledger,
     metrics_exposition,
+    obs_registry,
     silent_except,
     slot_protocol,
 )
@@ -28,4 +29,5 @@ RULES = (
     metrics_exposition,
     context_propagation,
     slot_protocol,
+    obs_registry,
 )

@@ -65,17 +65,24 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     from imaginary_tpu.engine import pressure as pressure_mod
 
     governor = pressure_mod.from_options(o)
+    # SLO burn-rate engine (obs/slo.py): built ONCE here like the qos
+    # policy — the trace middleware feeds it per-request, the service
+    # exposes it on /health //metrics //debugz. None when --slo-config
+    # is unset: every consumer takes its parity path.
+    from imaginary_tpu.obs import slo as slo_mod
+
+    slo = slo_mod.from_options(o)
     # trace middleware is OUTERMOST: it assigns request identity and
     # installs the contextvar trace before the access log (which reads
     # the id) and everything inside it runs
     app = web.Application(
         middlewares=[trace_middleware(o, log_stream, qos=qos,
-                                      pressure=governor),
+                                      pressure=governor, slo=slo),
                      access_log_middleware(o.log_level, log_stream)]
         + build_middlewares(o, qos=qos),
         client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
     )
-    service = ImageService(o, qos=qos, pressure=governor)
+    service = ImageService(o, qos=qos, pressure=governor, slo=slo)
     app["service"] = service
     app["options"] = o
 
@@ -130,7 +137,12 @@ async def _metrics(service, request):
     from imaginary_tpu.web.handlers import collect_health_stats
     from imaginary_tpu.web.metrics import render_metrics
 
-    return web.Response(text=render_metrics(collect_health_stats(service)),
+    # ?exemplars=1 opts into OpenMetrics exemplar clauses on histogram
+    # buckets; default off — the plain scrape stays byte-identical and
+    # strict-0.0.4-parseable
+    exemplars = request.query.get("exemplars", "") in ("1", "true")
+    return web.Response(text=render_metrics(collect_health_stats(service),
+                                            exemplars=exemplars),
                         content_type="text/plain", charset="utf-8")
 
 

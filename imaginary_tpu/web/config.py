@@ -140,6 +140,12 @@ class ServerOptions:
     # the slowloris shape that would otherwise pin a worker slot
     # through a rolling drain. 0 = off (parity; aiohttp defaults).
     read_timeout_s: float = 0.0
+    # Supervisor admin plane (obs/aggregate.py): a 127.0.0.1-only HTTP
+    # port serving the fleet-merged /metrics (reset-corrected counter
+    # sums across workers) and /fleetz (supervisor process table +
+    # per-worker /health side by side). 0 = off (parity: no socket is
+    # opened, no scrape loop exists). Only meaningful with --workers>1.
+    fleet_admin_port: int = 0
     # --- multi-tenant QoS (imaginary_tpu/qos/) -------------------------------
     # Tenant table + scheduler/shed knobs: inline JSON (starts with '{')
     # or a file path; parsed once at assembly (qos/tenancy.load_policy).
@@ -214,6 +220,16 @@ class ServerOptions:
     # One structured JSON line per request (obs/events.py schema), written
     # to the access-log stream. Off by default.
     wide_events: bool = False
+    # Tail-based sampling for the boring wide events: the interesting
+    # tail (errors/sheds/504s/hedges/placement trouble/fenced/slow) is
+    # ALWAYS emitted; boring successes roll this probability. 1.0 (the
+    # default) keeps everything — byte-identical event volume to the
+    # pre-sampling build (parity).
+    wide_events_sample: float = 1.0
+    # Per-route SLO objectives (obs/slo.py): inline JSON or a file
+    # path, same convention as --qos-config. "" = OFF (parity: no
+    # engine is built, /health //metrics //debugz carry no slo block).
+    slo_config: str = ""
     # /debugz runtime introspection (task dump, executor/cache snapshots,
     # slow-request exemplars, one-shot profiler). Off by default: it is an
     # information surface an internet-facing deployment must opt into.

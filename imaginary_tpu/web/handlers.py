@@ -80,7 +80,8 @@ class ImageService:
     """Owns the micro-batch executor, the host thread pool (decode/encode
     parallelism), and the source registry."""
 
-    def __init__(self, o: ServerOptions, qos=None, pressure=None):
+    def __init__(self, o: ServerOptions, qos=None, pressure=None,
+                 slo=None):
         self.options = o
         # multi-tenant QoS policy (imaginary_tpu/qos/): create_app builds
         # it once and passes it in; direct constructors (tests, benches)
@@ -99,6 +100,15 @@ class ImageService:
 
             pressure = pressure_mod.from_options(o)
         self.pressure = pressure
+        # SLO burn-rate engine (obs/slo.py): same pattern — create_app
+        # builds and shares it (the trace middleware feeds it), direct
+        # constructors derive it from the options. None = off (parity:
+        # no slo block on /health //metrics //debugz).
+        if slo is None and o.slo_config:
+            from imaginary_tpu.obs import slo as slo_mod
+
+            slo = slo_mod.from_options(o)
+        self.slo = slo
         # content-addressed cache tiers (imaginary_tpu/cache.py): result
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
@@ -663,7 +673,8 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
     never drift — /metrics promises 'the same numbers as /health')."""
     stats = get_health_stats(service.executor if service else None,
                              qos=service.qos if service else None,
-                             pressure=service.pressure if service else None)
+                             pressure=service.pressure if service else None,
+                             slo=service.slo if service else None)
     if service is not None:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads

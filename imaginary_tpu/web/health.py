@@ -27,7 +27,8 @@ def _rss_mb() -> float:
     return 0.0
 
 
-def get_health_stats(executor=None, qos=None, pressure=None) -> dict:
+def get_health_stats(executor=None, qos=None, pressure=None,
+                     slo=None) -> dict:
     import gc
 
     stats = {
@@ -85,6 +86,12 @@ def get_health_stats(executor=None, qos=None, pressure=None) -> dict:
         # and ladder-action counts; /metrics renders the same block as
         # imaginary_tpu_pressure_* so the two surfaces cannot drift
         stats["pressure"] = pressure.snapshot()
+    if slo is not None:
+        # per-route burn rates over 5m/1h windows (obs/slo.py); /metrics
+        # renders the same block as imaginary_tpu_slo_* so the two
+        # surfaces cannot drift. Absent with --slo-config unset — the
+        # block's presence IS the armed/parity signal.
+        stats["slo"] = slo.snapshot()
     from imaginary_tpu.engine.timing import TIMES
 
     stage_times = TIMES.snapshot()

@@ -69,8 +69,14 @@ class _Exposition:
         )
 
 
-def render_metrics(stats: dict) -> str:
-    """Health-stats dict + obs registry -> Prometheus exposition text."""
+def render_metrics(stats: dict, exemplars: bool = False) -> str:
+    """Health-stats dict + obs registry -> Prometheus exposition text.
+
+    exemplars=True (the /metrics?exemplars=1 opt-in) appends
+    OpenMetrics-style ` # {trace_id=,request_id=} value` clauses to the
+    latency histogram buckets; off by default because the strict 0.0.4
+    parser — and byte parity with the pre-exemplar build — rejects them.
+    """
     x = _Exposition()
     # deferred so each family's samples stay contiguous (the format
     # requires grouping; the stage loop would otherwise interleave the
@@ -84,6 +90,7 @@ def render_metrics(stats: dict) -> str:
     integrity: dict = {}
     fleet: dict = {}
     ingress: dict = {}
+    slo: dict = {}
     oom_splits = None
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
@@ -112,6 +119,8 @@ def render_metrics(stats: dict) -> str:
             fleet = value
         elif key == "ingress" and isinstance(value, dict):
             ingress = value
+        elif key == "slo" and isinstance(value, dict):
+            slo = value
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
@@ -334,6 +343,32 @@ def render_metrics(stats: dict) -> str:
                pressure.get("pixel_clamps", 0), mtype="counter",
                help_text="Requests rejected 413 by the critical-rung "
                          "pixel-admission clamp.")
+    # SLO burn rates (obs/slo.py snapshot, only with --slo-config):
+    # deferred-list style so each family's route/kind/window-labeled
+    # samples stay contiguous
+    slo_burn: list = []
+    slo_budget: list = []
+    for route, entry in sorted((slo.get("routes") or {}).items()):
+        rlab = escape_label_value(route)
+        for kind in ("availability", "latency"):
+            block = entry.get(kind) or {}
+            for window in ("5m", "1h"):
+                v = block.get(f"burn_{window}")
+                if v is not None:
+                    slo_burn.append(
+                        (f'route="{rlab}",slo="{kind}",window="{window}"', v))
+            if "budget_remaining" in block:
+                slo_budget.append(
+                    (f'route="{rlab}",slo="{kind}"',
+                     block["budget_remaining"]))
+    for labels, v in slo_burn:
+        x.emit("imaginary_tpu_slo_burn_rate", v, labels,
+               help_text="Error-budget burn rate per route/objective/"
+                         "window (1.0 = spending exactly the budget).")
+    for labels, v in slo_budget:
+        x.emit("imaginary_tpu_slo_error_budget_remaining", v, labels,
+               help_text="Fraction of the error budget left this hour "
+                         "per route/objective (hour-as-period proxy).")
     for labels, v in stage_total:
         x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
                help_text="Samples recorded per pipeline stage.")
@@ -343,5 +378,5 @@ def render_metrics(stats: dict) -> str:
                          "process window; use the _duration_seconds "
                          "histograms for fleet aggregation).")
     # layer 2: request/stage duration histograms + RED counters
-    x.lines.extend(REGISTRY.render_lines())
+    x.lines.extend(REGISTRY.render_lines(exemplars=exemplars))
     return "\n".join(x.lines) + "\n"

@@ -125,21 +125,29 @@ def _route_label(request: web.Request) -> str:
 
 
 def trace_middleware(o: ServerOptions, events_out=None, qos=None,
-                     pressure=None):
+                     pressure=None, slo=None):
     """Outermost middleware: request identity + trace lifecycle.
 
     Assigns/propagates X-Request-ID and W3C traceparent, installs the
     contextvar-carried RequestTrace every inner layer records spans into
     (access log included — it runs inside this and reads the id), then on
     the way out: echoes X-Request-ID, emits Server-Timing, observes the
-    request-duration histogram + RED counters, feeds the slow-request
-    exemplar ring, and (opt-in) writes the JSON wide event.
+    request-duration histogram + RED counters (with the request's
+    identity as a bucket exemplar when tracing is on), feeds the SLO
+    engine when one is armed, feeds the slow-request exemplar ring, and
+    (opt-in) writes the JSON wide event — tail-sampled: the interesting
+    tail always emits, boring successes roll --wide-events-sample.
 
     With a qos policy, tenant identity is resolved HERE, next to the
     request id it is the multi-tenant sibling of: the TenantSpec rides
     the trace contextvar so the throttle, the admission gate, and the
     executor scheduler (via pool-thread copy_context) all read one
     stamp, and tenant+class land in wide events / the slow ring."""
+    from imaginary_tpu.web.workers import worker_epoch, worker_index
+
+    # resolved once: fixed for the life of this serving process (the
+    # supervisor stamps both into the environment before exec)
+    widx, wepoch = worker_index(), worker_epoch()
 
     @web.middleware
     async def mw(request: web.Request, handler):
@@ -205,8 +213,12 @@ def trace_middleware(o: ServerOptions, events_out=None, qos=None,
             obs_trace.deactivate(token)
             elapsed = time.monotonic() - t0
             route = _route_label(request)
-            obs_hist.REQUEST_SECONDS.observe(elapsed)
+            obs_hist.REQUEST_SECONDS.observe(
+                elapsed, exemplar=tr.exemplar() if tr.enabled else None
+            )
             obs_hist.REQUESTS_TOTAL.inc((route, f"{status // 100}xx"))
+            if slo is not None:
+                slo.observe(route, status, elapsed)
             if resp is not None:
                 resp.headers["X-Request-ID"] = tr.request_id
                 if tr.enabled:
@@ -234,9 +246,19 @@ def trace_middleware(o: ServerOptions, events_out=None, qos=None,
                     duration_ms=round(elapsed * 1000.0, 3),
                     bytes_out=(resp.content_length or 0)
                     if resp is not None else 0,
+                    # merged streams from N workers are attributable:
+                    # which process, which fencing generation
+                    worker=widx,
+                    epoch=wepoch,
+                )
+                # classify BEFORE the slow ring notes the event: /debugz
+                # entries carry the same sampled_reason the emitted line
+                # does, so the two surfaces tell one story
+                event["sampled_reason"] = obs_events.classify(
+                    event, o.wide_events_sample
                 )
                 obs_slow.note(event)
-                if o.wide_events:
+                if o.wide_events and event["sampled_reason"] != "unsampled":
                     obs_events.emit(event, events_out)
 
     return mw

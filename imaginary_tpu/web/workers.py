@@ -282,7 +282,8 @@ class _ReadyWaiter:
 
 
 def run_supervisor(argv: list, workers: int, health_url: str = "",
-                   fleet=None, roll_grace_s: float = 5.0) -> int:
+                   fleet=None, roll_grace_s: float = 5.0,
+                   admin_port: int = 0) -> int:
     """Spawn and babysit `workers` serving processes; returns an exit code.
 
     Lifecycle: SIGTERM/SIGINT here fans out to every worker (each drains
@@ -296,6 +297,11 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
     (see the module docstring for the protocol). `fleet` is the shared
     cache (fleet/shmcache.ShmCache) whose epoch table fences deposed
     workers; None when --fleet-cache-mb is off (epochs still ride env).
+
+    With `admin_port` > 0 (and a health_url to derive scrape targets
+    from), the supervisor also serves the fleet observability plane on
+    127.0.0.1:admin_port — the merged reset-corrected /metrics and the
+    /fleetz process-table view (obs/aggregate.FleetAdmin).
     """
     check_reuseport()
     probe_interval = _env_f("IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL", 2.0)
@@ -313,6 +319,9 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
     spawn_t: dict = {}
     epochs: dict = {}
     restarts = {i: [] for i in range(workers)}
+    # lifetime (not budget-windowed) restart counts, for /fleetz: an
+    # operator asking "how churny has worker 2 been" wants the total
+    restart_totals = {i: 0 for i in range(workers)}
     consec_restarts = {i: 0 for i in range(workers)}
     respawn_at: dict = {}  # idx -> monotonic time the backoff allows it
     terminating: list = []  # (proc, sigkill_deadline) for draining workers
@@ -363,6 +372,51 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
         probe = _LivenessProbe(health_url, workers, probe_interval,
                                probe_timeout)
 
+    admin = None
+    if admin_port > 0 and health_url:
+        # Fleet observability plane (obs/aggregate.py): merged /metrics
+        # + /fleetz on loopback. The view closure reads the supervisor's
+        # own state dicts — int/handle reads under the GIL, served from
+        # the admin's request threads while this loop mutates them.
+        from imaginary_tpu.obs.aggregate import FleetAdmin
+
+        metrics_url = health_url[: -len("/health")] + "/metrics"
+        _admin_ctx = _ssl_ctx_for(health_url)
+
+        def _admin_fetch(url: str, timeout: float) -> str:
+            # Connection: close — each scrape sample must land on a
+            # FRESH SO_REUSEPORT pick, not ride a kept-alive pipe to
+            # the same worker (and a TLS fleet needs the probe's
+            # self-signed-tolerant context)
+            import urllib.request
+
+            req = urllib.request.Request(
+                url, headers={"Connection": "close"})
+            with urllib.request.urlopen(
+                    req, timeout=timeout, context=_admin_ctx) as r:
+                return r.read().decode("utf-8", "replace")
+
+        def _admin_view() -> dict:
+            now = time.monotonic()
+            view = {}
+            for i, p in list(procs.items()):
+                seen = probe.seen_at(i) if probe is not None else None
+                view[i] = {
+                    "pid": p.pid,
+                    "alive": p.poll() is None,
+                    "epoch": epochs.get(i, 0),
+                    "restarts": restart_totals.get(i, 0),
+                    "spawned_s_ago": round(now - spawn_t.get(i, now), 1),
+                    "liveness_age_s": round(now - seen, 1)
+                    if seen is not None else None,
+                }
+            return view
+
+        admin = FleetAdmin(admin_port, metrics_url, health_url,
+                           _admin_view, fetch=_admin_fetch).start()
+        print(f"imaginary-tpu supervisor: fleet admin plane on "
+              f"127.0.0.1:{admin.port} (/metrics /fleetz)")
+
     def charge_restart(i: int, now: float) -> bool:
         """Book one restart against worker i's budget; False = exhausted.
         Planned rolls never charge — the budget meters FAILURES."""
@@ -370,6 +424,7 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
         if len(restarts[i]) >= restart_budget:
             return False
         restarts[i].append(now)
+        restart_totals[i] += 1
         # survived long enough since its last (re)spawn? the crash loop
         # is over — start the backoff ladder from the bottom again
         if now - spawn_t.get(i, 0.0) > 60.0:
@@ -564,6 +619,8 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
                 spawn(i)
         time.sleep(0.2)
 
+    if admin is not None:
+        admin.close()
     if probe is not None:
         probe.close()
     reap = list(procs.values()) + [p for p, _ in terminating]

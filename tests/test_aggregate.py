@@ -1,0 +1,395 @@
+"""Fleet metrics aggregation (imaginary_tpu/obs/aggregate.py).
+
+The ISSUE 13 merged-exposition contract: two synthetic worker snapshots
+(one mid-respawn with reset counters) merge to monotonic fleet totals
+that pass the PR 3 strict exposition parser; gauge families follow the
+mergeable-vs-per-worker discipline (summing the shared shm's slot gauge
+over N workers would N-x double-count); /fleetz degrades gracefully
+(partial data + `stale` flag) when a worker never answers the scrape;
+and the FleetAdmin HTTP server serves both views end to end.
+
+Everything here is supervisor-side and stdlib-only — no jax, no
+aiohttp, no live fleet (tests/test_workers.py covers the real
+2-worker subprocess path).
+"""
+
+import http.client
+import itertools
+import json
+import threading
+
+import pytest
+
+from imaginary_tpu.obs.aggregate import (
+    Aggregator,
+    FleetAdmin,
+    build_fleetz,
+    merge_mode,
+    parse_exposition,
+    scrape_fleet,
+)
+from tests.test_obs import check_histograms, parse_exposition_strict
+
+
+def worker_exposition(worker: int, epoch: int, requests: float,
+                      bucket_01: float, threads: float = 7,
+                      fleet_slots: float = 128.0) -> str:
+    """A minimal but representative worker /metrics body: identity
+    gauges, a RED counter, a histogram, a summable gauge, and the
+    shared-shm slot gauge every worker reports identically."""
+    dur_sum = requests * 0.05
+    return (
+        "# HELP imaginary_tpu_worker Worker index of the serving process.\n"
+        "# TYPE imaginary_tpu_worker gauge\n"
+        f"imaginary_tpu_worker {worker}\n"
+        "# HELP imaginary_tpu_epoch Supervisor-minted fencing epoch.\n"
+        "# TYPE imaginary_tpu_epoch gauge\n"
+        f"imaginary_tpu_epoch {epoch}\n"
+        "# HELP imaginary_tpu_requests_total Requests by route and class.\n"
+        "# TYPE imaginary_tpu_requests_total counter\n"
+        f'imaginary_tpu_requests_total{{route="resize",code="2xx"}} '
+        f"{requests}\n"
+        "# HELP imaginary_tpu_request_duration_seconds End-to-end latency.\n"
+        "# TYPE imaginary_tpu_request_duration_seconds histogram\n"
+        f'imaginary_tpu_request_duration_seconds_bucket{{le="0.1"}} '
+        f"{bucket_01}\n"
+        f'imaginary_tpu_request_duration_seconds_bucket{{le="+Inf"}} '
+        f"{requests}\n"
+        f"imaginary_tpu_request_duration_seconds_sum {dur_sum}\n"
+        f"imaginary_tpu_request_duration_seconds_count {requests}\n"
+        "# HELP imaginary_tpu_threads Live threads in this process.\n"
+        "# TYPE imaginary_tpu_threads gauge\n"
+        f"imaginary_tpu_threads {threads}\n"
+        "# HELP imaginary_tpu_fleet_slots Slots in the shared shm cache.\n"
+        "# TYPE imaginary_tpu_fleet_slots gauge\n"
+        f"imaginary_tpu_fleet_slots {fleet_slots}\n"
+        "# HELP imaginary_tpu_rss_mb Resident set size.\n"
+        "# TYPE imaginary_tpu_rss_mb gauge\n"
+        f"imaginary_tpu_rss_mb {100 + worker}\n"
+    )
+
+
+def health_body(worker: int, epoch: int) -> str:
+    return json.dumps({"worker": worker, "epoch": epoch,
+                       "uptime": 12.5, "backend": "cpu"})
+
+
+class TestParseExposition:
+    def test_histogram_samples_fold_into_base_family(self):
+        fams = parse_exposition(worker_exposition(0, 1, 10, 8))
+        hist = fams["imaginary_tpu_request_duration_seconds"]
+        assert hist.mtype == "histogram"
+        sample_names = {name for name, _ in hist.samples}
+        assert sample_names == {
+            "imaginary_tpu_request_duration_seconds_bucket",
+            "imaginary_tpu_request_duration_seconds_sum",
+            "imaginary_tpu_request_duration_seconds_count",
+        }
+
+    def test_labels_and_values(self):
+        fams = parse_exposition(worker_exposition(0, 1, 10, 8))
+        red = fams["imaginary_tpu_requests_total"]
+        ((name, labels),) = [k for k in red.samples]
+        assert name == "imaginary_tpu_requests_total"
+        assert dict(labels) == {"route": "resize", "code": "2xx"}
+        assert red.samples[(name, labels)] == 10.0
+
+    def test_tolerates_openmetrics_exemplar_clause(self):
+        text = (
+            "# TYPE imaginary_tpu_request_duration_seconds histogram\n"
+            'imaginary_tpu_request_duration_seconds_bucket{le="0.1"} 8'
+            ' # {trace_id="abc",request_id="rid"} 0.07\n'
+        )
+        fams = parse_exposition(text)
+        hist = fams["imaginary_tpu_request_duration_seconds"]
+        assert list(hist.samples.values()) == [8.0]
+
+
+class TestMergeMode:
+    def test_counters_and_histograms_sum(self):
+        assert merge_mode("imaginary_tpu_requests_total", "counter") == "sum"
+        assert merge_mode(
+            "imaginary_tpu_request_duration_seconds", "histogram") == "sum"
+
+    def test_shared_shm_gauges_never_sum(self):
+        # every worker reports the SAME shm file: summing double-counts
+        assert merge_mode("imaginary_tpu_fleet_slots", "gauge") == "per_worker"
+        assert merge_mode("imaginary_tpu_fleet_used_bytes",
+                          "gauge") == "per_worker"
+
+    def test_per_process_quantities_sum_only_when_allowlisted(self):
+        assert merge_mode("imaginary_tpu_executor_queue_depth",
+                          "gauge") == "sum"
+        assert merge_mode("imaginary_tpu_threads", "gauge") == "sum"
+        # categorical / identity / per-process state: labeled, not summed
+        assert merge_mode("imaginary_tpu_rss_mb", "gauge") == "per_worker"
+        assert merge_mode("imaginary_tpu_pressure_state",
+                          "gauge") == "per_worker"
+
+
+class TestAggregatorMonotonicity:
+    def test_two_workers_sum(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 100, 80)))
+        agg.observe(1, 2, parse_exposition(worker_exposition(1, 2, 40, 30)))
+        types, samples = parse_exposition_strict(agg.render())
+        red = {tuple(sorted(labels.items())): v for n, labels, v in samples
+               if n == "imaginary_tpu_requests_total"}
+        assert list(red.values()) == [140.0]
+
+    def test_respawn_reset_never_goes_backwards(self):
+        # worker 1 crashes at 40 requests and respawns (epoch 2 -> 5)
+        # with counters back at zero; the merged total must never dip
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 100, 80)))
+        agg.observe(1, 2, parse_exposition(worker_exposition(1, 2, 40, 30)))
+
+        def fleet_total():
+            _, samples = parse_exposition_strict(agg.render())
+            return next(v for n, _l, v in samples
+                        if n == "imaginary_tpu_requests_total")
+
+        assert fleet_total() == 140.0
+        agg.observe(1, 5, parse_exposition(worker_exposition(1, 5, 0, 0)))
+        assert fleet_total() == 140.0  # dead epoch folded into the base
+        agg.observe(1, 5, parse_exposition(worker_exposition(1, 5, 7, 5)))
+        assert fleet_total() == 147.0
+        # histogram counts ride the same correction
+        _, samples = parse_exposition_strict(agg.render())
+        count = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_request_duration_seconds_count")
+        assert count == 147.0
+
+    def test_same_epoch_regression_clamped(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 50, 40)))
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 44, 40)))
+        _, samples = parse_exposition_strict(agg.render())
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 50.0
+
+    def test_older_epoch_scrape_ignored(self):
+        # a deposed zombie's last gasp racing its replacement
+        agg = Aggregator()
+        agg.observe(0, 3, parse_exposition(worker_exposition(0, 3, 10, 8)))
+        agg.observe(0, 2, parse_exposition(
+            worker_exposition(0, 2, 9999, 9999)))
+        _, samples = parse_exposition_strict(agg.render())
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 10.0
+
+
+class TestMergedRender:
+    def _agg(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 100, 80)))
+        agg.observe(1, 2, parse_exposition(worker_exposition(1, 2, 40, 30)))
+        return agg
+
+    def test_strict_parse_and_histogram_consistency(self):
+        types, samples = parse_exposition_strict(self._agg().render())
+        check_histograms(types, samples)
+        assert types["imaginary_tpu_requests_total"] == "counter"
+        assert types["imaginary_tpu_request_duration_seconds"] == "histogram"
+
+    def test_gauge_discipline_in_merged_view(self):
+        _, samples = parse_exposition_strict(self._agg().render())
+        by_name: dict = {}
+        for n, labels, v in samples:
+            by_name.setdefault(n, []).append((labels, v))
+        # allowlisted gauge summed into one series
+        ((labels, v),) = by_name["imaginary_tpu_threads"]
+        assert "worker" not in labels and v == 14.0
+        # shared-shm gauge split per worker, never summed
+        slots = by_name["imaginary_tpu_fleet_slots"]
+        assert sorted(labels["worker"] for labels, _ in slots) == ["0", "1"]
+        assert all(v == 128.0 for _, v in slots)
+        # identity gauge dropped from the merged view entirely
+        assert "imaginary_tpu_worker" not in by_name
+        # per-process gauge labeled by worker
+        rss = {labels["worker"]: v
+               for labels, v in by_name["imaginary_tpu_rss_mb"]}
+        assert rss == {"0": 100.0, "1": 101.0}
+
+    def test_per_worker_debug_view(self):
+        text = self._agg().render(per_worker=True)
+        types, samples = parse_exposition_strict(text)
+        red = [(labels, v) for n, labels, v in samples
+               if n == "imaginary_tpu_requests_total"]
+        assert {labels["worker"]: v for labels, v in red} \
+            == {"0": 100.0, "1": 40.0}
+
+    def test_extra_gauges_appended(self):
+        text = self._agg().render(extra_gauges=[
+            ("imaginary_tpu_fleet_admin_workers", "tracked workers", 2)])
+        types, samples = parse_exposition_strict(text)
+        assert types["imaginary_tpu_fleet_admin_workers"] == "gauge"
+        assert any(n == "imaginary_tpu_fleet_admin_workers" and v == 2.0
+                   for n, _l, v in samples)
+
+
+# --- shared-port scraping -----------------------------------------------------
+
+
+def round_robin_fetch(bodies_by_kind):
+    """fetch(url, timeout) that cycles each URL kind through a list of
+    bodies — models the kernel's SO_REUSEPORT pick landing on successive
+    workers. A body of None raises TimeoutError (worker not answering)."""
+    counters = {kind: itertools.cycle(bodies)
+                for kind, bodies in bodies_by_kind.items()}
+    lock = threading.Lock()
+
+    def fetch(url, timeout):
+        kind = "metrics" if "/metrics" in url else "health"
+        with lock:
+            body = next(counters[kind])
+        if body is None:
+            raise TimeoutError("worker did not answer")
+        return body
+
+    return fetch
+
+
+class TestScrapeFleet:
+    def test_full_coverage(self):
+        fetch = round_robin_fetch({
+            "metrics": [worker_exposition(0, 1, 10, 8),
+                        worker_exposition(1, 1, 20, 15)],
+            "health": [health_body(0, 1), health_body(1, 1)],
+        })
+        metrics_by, health_by, missed = scrape_fleet(
+            "http://x/metrics", "http://x/health", {0, 1},
+            deadline_s=2.0, fetch=fetch)
+        assert missed == set()
+        assert set(metrics_by) == {0, 1} and set(health_by) == {0, 1}
+        assert metrics_by[0][0] == 1  # epoch rode along
+        assert health_by[1]["worker"] == 1
+
+    def test_unresponsive_worker_reported_missed(self):
+        # worker 1 never answers: every sample lands on worker 0 or
+        # times out; the scrape must return partial data, not hang or 500
+        fetch = round_robin_fetch({
+            "metrics": [worker_exposition(0, 1, 10, 8), None],
+            "health": [health_body(0, 1), None],
+        })
+        metrics_by, health_by, missed = scrape_fleet(
+            "http://x/metrics", "http://x/health", {0, 1},
+            deadline_s=0.3, per_request_timeout=0.05, fetch=fetch)
+        assert missed == {1}
+        assert set(metrics_by) == {0} and set(health_by) == {0}
+
+    def test_higher_epoch_wins_within_one_scrape(self):
+        # zombie + replacement both answering during a roll: keep the new
+        fetch = round_robin_fetch({
+            "metrics": [worker_exposition(0, 4, 3, 2),
+                        worker_exposition(0, 3, 900, 900)],
+            "health": [health_body(0, 4), health_body(0, 3)],
+        })
+        metrics_by, health_by, missed = scrape_fleet(
+            "http://x/metrics", "http://x/health", {0},
+            deadline_s=0.3, fetch=fetch)
+        assert metrics_by[0][0] == 4
+        assert health_by[0]["epoch"] == 4
+
+
+class TestFleetz:
+    def test_stale_flag_on_missed_worker(self):
+        view = {
+            0: {"pid": 11, "alive": True, "epoch": 1, "restarts": 0},
+            1: {"pid": 12, "alive": True, "epoch": 3, "restarts": 2},
+        }
+        payload = build_fleetz(view, {0: json.loads(health_body(0, 1))},
+                               missed={1}, now=123.0)
+        w = payload["workers"]
+        assert w["0"]["stale"] is False
+        assert w["0"]["health"]["backend"] == "cpu"
+        # the missed worker still appears with supervisor truth
+        assert w["1"]["stale"] is True and w["1"]["health"] is None
+        assert w["1"]["pid"] == 12 and w["1"]["restarts"] == 2
+        assert payload["missed"] == [1]
+        assert payload["scraped"] == [0]
+
+
+# --- the admin HTTP server, end to end ----------------------------------------
+
+
+@pytest.fixture
+def admin():
+    fetch = round_robin_fetch({
+        "metrics": [worker_exposition(0, 1, 100, 80),
+                    worker_exposition(1, 2, 40, 30)],
+        "health": [health_body(0, 1), health_body(1, 2)],
+    })
+
+    def view():
+        return {0: {"pid": 11, "alive": True, "epoch": 1, "restarts": 0},
+                1: {"pid": 12, "alive": True, "epoch": 2, "restarts": 1}}
+
+    srv = FleetAdmin(0, "http://shared/metrics", "http://shared/health",
+                     view, scrape_deadline_s=1.0, fetch=fetch).start()
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+def _get(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8")
+    finally:
+        conn.close()
+
+
+class TestFleetAdminHTTP:
+    def test_merged_metrics_strict_and_summed(self, admin):
+        status, text = _get(admin.port, "/metrics")
+        assert status == 200
+        types, samples = parse_exposition_strict(text)
+        check_histograms(types, samples)
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 140.0
+        # the synthetic supervisor gauges ride along
+        assert any(n == "imaginary_tpu_fleet_admin_workers" and v == 2.0
+                   for n, _l, v in samples)
+        assert any(n == "imaginary_tpu_fleet_admin_workers_unscraped"
+                   and v == 0.0 for n, _l, v in samples)
+
+    def test_per_worker_query(self, admin):
+        status, text = _get(admin.port, "/metrics?per_worker=1")
+        assert status == 200
+        _, samples = parse_exposition_strict(text)
+        red = {labels["worker"]: v for n, labels, v in samples
+               if n == "imaginary_tpu_requests_total"}
+        assert red == {"0": 100.0, "1": 40.0}
+
+    def test_fleetz_shape(self, admin):
+        status, text = _get(admin.port, "/fleetz")
+        assert status == 200
+        payload = json.loads(text)
+        assert set(payload["workers"]) == {"0", "1"}
+        assert payload["workers"]["1"]["restarts"] == 1
+        assert payload["workers"]["1"]["health"]["epoch"] == 2
+        assert payload["missed"] == []
+
+    def test_unknown_path_404(self, admin):
+        status, _ = _get(admin.port, "/nope")
+        assert status == 404
+
+    def test_totals_monotonic_across_admin_requests(self, admin):
+        # the persistent Aggregator means a second scrape that catches a
+        # freshly-respawned worker cannot regress the merged totals
+        _, text1 = _get(admin.port, "/metrics")
+        _, samples1 = parse_exposition_strict(text1)
+        _, text2 = _get(admin.port, "/metrics")
+        _, samples2 = parse_exposition_strict(text2)
+        t1 = next(v for n, _l, v in samples1
+                  if n == "imaginary_tpu_requests_total")
+        t2 = next(v for n, _l, v in samples2
+                  if n == "imaginary_tpu_requests_total")
+        assert t2 >= t1

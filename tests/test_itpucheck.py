@@ -343,6 +343,82 @@ class TestSlotProtocol:
         assert findings == []
 
 
+class TestObsRegistry:
+    def test_trips_all_five_directions(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "events.py": (
+                "SAMPLED_REASONS = (\n"
+                "    'error',\n"
+                "    'random',\n"
+                "    'stale_entry',\n"
+                ")\n"
+                "def classify(event):\n"
+                "    if event.get('status', 0) >= 400:\n"
+                "        return 'error'\n"
+                "    if event.get('typo'):\n"
+                "        return 'typo_reason'\n"
+                "    return 'random'\n"
+            ),
+            "slo.py": (
+                "SLO_METRICS = (\n"
+                "    'imaginary_tpu_slo_burn_rate',\n"
+                "    'imaginary_tpu_slo_ghost',\n"
+                ")\n"
+            ),
+            "m.py": (
+                "def f(x, event, v):\n"
+                "    if event['sampled_reason'] == 'nonsense':\n"
+                "        return 1\n"
+                "    x.emit('imaginary_tpu_slo_burn_rate', v)\n"
+                "    x.emit('imaginary_tpu_slo_typo_total', v)\n"
+            ),
+        }, rules=["ITPU010"])
+        msgs = "\n".join(f.message for f in findings)
+        assert "typo_reason" in msgs         # classify mints undeclared
+        assert "nonsense" in msgs            # compared-against undeclared
+        assert "stale_entry" in msgs         # declared, never used
+        assert "imaginary_tpu_slo_typo_total" in msgs  # rendered undeclared
+        assert "imaginary_tpu_slo_ghost" in msgs       # declared, unrendered
+        assert len(findings) == 5
+        assert _rules_hit(findings) == {"ITPU010"}
+
+    def test_registries_in_sync_pass(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "events.py": (
+                "SAMPLED_REASONS = (\n"
+                "    'error',\n"
+                "    'random',\n"
+                "    'unsampled',\n"
+                ")\n"
+                "def classify(event):\n"
+                "    if event.get('status', 0) >= 400:\n"
+                "        return 'error'\n"
+                "    return 'random'\n"
+            ),
+            "slo.py": (
+                "SLO_METRICS = (\n"
+                "    'imaginary_tpu_slo_burn_rate',\n"
+                ")\n"
+            ),
+            "m.py": (
+                "def f(x, ev, v):\n"
+                "    if ev.get('sampled_reason') != 'unsampled':\n"
+                "        x.emit_line(ev)\n"
+                "    x.emit('imaginary_tpu_slo_burn_rate', v)\n"
+            ),
+        }, rules=["ITPU010"])
+        assert findings == []
+
+    def test_silent_without_registry_modules(self, tmp_path):
+        # a tree without the registries (e.g. a partial scan of one
+        # subpackage) must not crash or spray findings
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def f(ev):\n"
+            "    return ev.get('sampled_reason')\n"
+        )}, rules=["ITPU010"])
+        assert findings == []
+
+
 # -- suppression grammar ------------------------------------------------------
 
 
@@ -416,8 +492,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 9 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 9
+        # all 10 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 10
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

@@ -20,6 +20,7 @@ from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
 from imaginary_tpu.obs import debugz as obs_debugz
+from imaginary_tpu.obs import events as obs_events
 from imaginary_tpu.obs import histogram as obs_hist
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.web.config import ServerOptions
@@ -568,3 +569,259 @@ class TestSlowRing:
         # the oldest entry (5.0) aged out of the keep=4 window
         assert [e["duration_ms"] for e in top] == [50.0, 20.0]
         assert len(ring.slowest(100)) == 4
+
+
+# --- tail-sampled wide events (ISSUE 13) --------------------------------------
+
+class TestClassify:
+    """classify() precedence: the most actionable signal wins."""
+
+    def test_interesting_tail_always_kept(self):
+        cases = [
+            ({"status": 503}, "shed"),
+            ({"status": 504}, "deadline"),
+            ({"status": 418}, "error"),
+            ({"status": 200, "hedge": "won"}, "hedged"),
+            ({"status": 200,
+              "placement_attempts": ["device:0:error", "host_spill"]},
+             "placement"),
+            ({"status": 200,
+              "placement_attempts": ["device:quarantined", "host_spill"]},
+             "placement"),
+            ({"status": 200, "fenced_publish": True}, "fenced"),
+            ({"status": 200, "duration_ms": 1500.0}, "slow"),
+        ]
+        for event, want in cases:
+            # sample=0: only the always-keep rules can save these events
+            assert obs_events.classify(event, sample=0.0) == want, event
+
+    def test_precedence_shed_beats_error_and_slow(self):
+        ev = {"status": 503, "duration_ms": 9000.0}
+        assert obs_events.classify(ev, sample=0.0) == "shed"
+        ev = {"status": 200, "hedge": "lost", "duration_ms": 9000.0}
+        assert obs_events.classify(ev, sample=0.0) == "hedged"
+
+    def test_boring_event_sampling(self):
+        boring = {"status": 200, "duration_ms": 3.0,
+                  "placement_attempts": ["device:0"]}
+        # default sample=1.0: everything kept (legacy parity)
+        assert obs_events.classify(boring) == "random"
+        assert obs_events.classify(boring, sample=0.0) == "unsampled"
+        # injectable roll pins the probabilistic branch deterministically
+        assert obs_events.classify(boring, sample=0.5,
+                                   roll=lambda: 0.4) == "random"
+        assert obs_events.classify(boring, sample=0.5,
+                                   roll=lambda: 0.6) == "unsampled"
+
+    def test_every_verdict_is_registered(self):
+        # the ITPU010 contract from the python side
+        for v in ("shed", "deadline", "error", "hedged", "placement",
+                  "fenced", "slow", "random", "unsampled"):
+            assert v in obs_events.SAMPLED_REASONS
+
+
+class TestTailSampling:
+    def test_sample_zero_keeps_only_the_interesting_tail(self):
+        stream = io.StringIO()
+
+        async def fn(client, _origin, _app):
+            for _ in range(5):
+                res = await client.post("/resize?width=100", data=jpg())
+                assert res.status == 200
+            res = await client.post("/resize?width=100", data=b"nope")
+            assert res.status >= 400
+
+            events = _wide_events(stream)
+            # the five boring 200s were dropped; the error survived
+            assert len(events) == 1
+            assert events[0]["status"] >= 400
+            assert events[0]["sampled_reason"] == "error"
+
+        obs_debugz.SLOW.clear()
+        run(ServerOptions(wide_events=True, wide_events_sample=0.0), fn,
+            log_stream=stream)
+
+    def test_default_sample_emits_everything_with_stamps(self):
+        stream = io.StringIO()
+
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            events = _wide_events(stream)
+            assert len(events) == 1
+            ev = events[0]
+            assert ev["sampled_reason"] == "random"
+            # fleet attribution stamps (satellite a): a standalone
+            # process is worker 0 at epoch 0
+            assert ev["worker"] == 0
+            assert ev["epoch"] == 0
+
+        run(ServerOptions(wide_events=True), fn, log_stream=stream)
+
+    def test_slow_ring_carries_verdict_even_for_unsampled(self):
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+
+        obs_debugz.SLOW.clear()
+        run(ServerOptions(wide_events=True, wide_events_sample=0.0), fn)
+        entries = obs_debugz.SLOW.slowest(10)
+        assert entries, "slow ring must record unsampled requests too"
+        ev = entries[0]
+        assert ev["sampled_reason"] == "unsampled"
+        assert ev["worker"] == 0 and ev["epoch"] == 0
+
+
+# --- exemplars (ISSUE 13) -----------------------------------------------------
+
+class TestExemplars:
+    def test_histogram_stores_and_renders_exemplar(self):
+        reg = obs_hist.Registry()
+        h = reg.histogram("ex_seconds", "help text", (0.1, 1.0))
+        h.observe(0.05, exemplar=("req-1", "trace-1"))
+        h.observe(0.5)
+        plain = "\n".join(reg.render_lines()) + "\n"
+        assert " # {" not in plain  # default render stays strict 0.0.4
+        parse_exposition_strict(plain)
+        rich = "\n".join(reg.render_lines(exemplars=True)) + "\n"
+        assert 'trace_id="trace-1"' in rich
+        assert 'request_id="req-1"' in rich
+        # only the bucket that saw the exemplar carries one
+        ex_lines = [ln for ln in rich.splitlines() if " # {" in ln]
+        assert len(ex_lines) == 1 and 'le="0.1"' in ex_lines[0]
+
+    def test_metrics_endpoint_exemplar_query(self):
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            rid = res.headers["X-Request-ID"]
+            # plain scrape: byte-strict, no exemplar clause
+            plain = await (await client.get("/metrics")).text()
+            assert " # {" not in plain
+            parse_exposition_strict(plain)
+            # opted-in scrape: the request-duration bucket names the
+            # exact request that landed in it
+            rich = await (await client.get("/metrics?exemplars=1")).text()
+            assert f'request_id="{rid}"' in rich
+            # stripping the exemplar clause restores a strict body
+            stripped = "\n".join(
+                ln.split(" # {")[0] for ln in rich.splitlines()) + "\n"
+            parse_exposition_strict(stripped)
+
+        run(ServerOptions(), fn)
+
+
+# --- SLO burn rates (ISSUE 13) ------------------------------------------------
+
+class TestSloEngine:
+    def test_load_config_inline_file_and_errors(self, tmp_path):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        objectives = slo_mod.load_config(
+            '{"/resize": {"latency_ms": 250, "latency_target": 0.99,'
+            ' "availability": 0.999}}')
+        assert objectives["/resize"].latency_ms == 250.0
+        p = tmp_path / "slo.json"
+        p.write_text('{"*": {"availability": 0.99}}')
+        objectives = slo_mod.load_config(str(p))
+        assert objectives["*"].availability == 0.99
+        # defaults fill unspecified fields
+        assert objectives["*"].latency_ms == 1000.0
+        for bad in ("{nope", '{"*": 5}', '{"*": {"availability": 1.5}}',
+                    '{"*": {"latency_ms": -1}}', str(tmp_path / "missing")):
+            with pytest.raises(ValueError):
+                slo_mod.load_config(bad)
+
+    def test_burn_rate_math(self):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        t = [1000.0]
+        eng = slo_mod.SloEngine(
+            slo_mod.load_config(
+                '{"*": {"availability": 0.999, "latency_ms": 100,'
+                ' "latency_target": 0.99}}'),
+            clock=lambda: t[0])
+        for _ in range(99):
+            eng.observe("/resize", 200, 0.01)
+        eng.observe("/resize", 500, 0.01)
+        snap = eng.snapshot()
+        r = snap["routes"]["/resize"]
+        # 1 bad / 100 total against a 0.1% budget => burn 10x
+        assert r["availability"]["burn_5m"] == pytest.approx(10.0)
+        assert r["availability"]["bad_5m"] == 1
+        assert r["availability"]["budget_remaining"] == 0.0
+        # no over-latency requests: latency burn 0, budget intact
+        assert r["latency"]["burn_5m"] == 0.0
+        assert r["latency"]["budget_remaining"] == 1.0
+
+    def test_sliding_window_forgets_old_badness(self):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        t = [1000.0]
+        eng = slo_mod.SloEngine(
+            slo_mod.load_config('{"*": {"availability": 0.999}}'),
+            clock=lambda: t[0])
+        eng.observe("/x", 500, 0.01)  # ring snapshot at t=1000
+        for _ in range(9):
+            eng.observe("/x", 200, 0.01)
+        t[0] += 6.0
+        eng.observe("/x", 200, 0.01)  # second ring snapshot
+        t[0] += 400.0  # the bad minute is now outside the 5m window...
+        eng.observe("/x", 200, 0.01)
+        snap = eng.snapshot()["routes"]["/x"]["availability"]
+        assert snap["bad_5m"] == 0
+        assert snap["burn_5m"] == 0.0
+        # ...but still inside the 1h window
+        assert snap["bad_1h"] == 1
+
+    def test_unmatched_route_without_catchall_ignored(self):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        eng = slo_mod.SloEngine(slo_mod.load_config(
+            '{"/resize": {"availability": 0.999}}'))
+        eng.observe("/other", 500, 0.01)
+        assert eng.snapshot()["routes"] == {}
+
+    def test_from_options_parity_off(self):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        assert slo_mod.from_options(ServerOptions()) is None
+        assert slo_mod.from_options(
+            ServerOptions(slo_config="  ")) is None
+
+
+class TestSloSurfaces:
+    SLO = '{"*": {"latency_ms": 500, "latency_target": 0.99, "availability": 0.999}}'
+
+    def test_health_metrics_and_debugz_blocks(self):
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            health = await (await client.get("/health")).json()
+            assert "slo" in health
+            route = health["slo"]["routes"]["/resize"]
+            assert route["total"] >= 1
+            assert "burn_5m" in route["availability"]
+            text = await (await client.get("/metrics")).text()
+            types, samples = parse_exposition_strict(text)
+            assert types["imaginary_tpu_slo_burn_rate"] == "gauge"
+            burn = [(labels, v) for n, labels, v in samples
+                    if n == "imaginary_tpu_slo_burn_rate"]
+            assert {labels["slo"] for labels, _ in burn} \
+                == {"availability", "latency"}
+            assert {labels["window"] for labels, _ in burn} == {"5m", "1h"}
+            assert any(n == "imaginary_tpu_slo_error_budget_remaining"
+                       for n, _l, _v in samples)
+            debug = await (await client.get("/debugz")).json()
+            assert "slo" in debug
+
+        run(ServerOptions(enable_debug=True, slo_config=self.SLO), fn)
+
+    def test_parity_no_slo_block_without_config(self):
+        async def fn(client, _origin, _app):
+            await client.post("/resize?width=100", data=jpg())
+            health = await (await client.get("/health")).json()
+            assert "slo" not in health
+            text = await (await client.get("/metrics")).text()
+            assert "imaginary_tpu_slo_" not in text
+
+        run(ServerOptions(), fn)

@@ -56,6 +56,7 @@ def _sample_pids(port: int, n: int = 24) -> set:
 def fleet(tmp_path_factory):
     from tests.conftest import free_port
     port = free_port()
+    admin_port = free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("IMAGINARY_TPU_WORKER", None)
@@ -67,13 +68,14 @@ def fleet(tmp_path_factory):
     sup = subprocess.Popen(
         [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
          "--port", str(port), "--fleet-cache-mb", "8",
-         "--fleet-roll-grace", "1.0"],
+         "--fleet-roll-grace", "1.0",
+         "--fleet-admin-port", str(admin_port)],
         cwd=ROOT, env=env,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     try:
         _wait_healthy(port)
-        yield port, sup, fleet_path
+        yield port, sup, fleet_path, admin_port
     finally:
         if sup.poll() is None:
             sup.send_signal(signal.SIGTERM)
@@ -85,7 +87,7 @@ def fleet(tmp_path_factory):
 
 
 def test_two_workers_share_one_port(fleet):
-    port, _, _ = fleet
+    port, _, _, _ = fleet
     # let the second worker finish booting before sampling the pair
     end = time.monotonic() + 45
     pids = set()
@@ -97,7 +99,7 @@ def test_two_workers_share_one_port(fleet):
 
 
 def test_crashed_worker_is_respawned(fleet):
-    port, _, _ = fleet
+    port, _, _, _ = fleet
     victim = _health(port)["pid"]
     os.kill(victim, signal.SIGKILL)
     # the supervisor notices within its 200 ms sweep and respawns; the
@@ -114,7 +116,7 @@ def test_crashed_worker_is_respawned(fleet):
 
 
 def test_requests_served_during_and_after_respawn(fleet):
-    port, _, _ = fleet
+    port, _, _, _ = fleet
     from tests.conftest import fixture_bytes
 
     body = fixture_bytes("imaginary.jpg")
@@ -131,7 +133,7 @@ def test_requests_served_during_and_after_respawn(fleet):
 
 
 def test_epochs_stamped_and_fleet_block_served(fleet):
-    port, _, fleet_path = fleet
+    port, _, fleet_path, _ = fleet
     # both worker indices carry supervisor-stamped epochs; with the
     # shared cache armed every /health response carries the fleet block
     seen = {}
@@ -157,9 +159,118 @@ def test_epochs_stamped_and_fleet_block_served(fleet):
         client.close()
 
 
+def _admin_get(admin_port: int, path: str, timeout: float = 15.0) -> str:
+    req = urllib.request.Request(f"http://127.0.0.1:{admin_port}{path}")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def _counter_series(text: str) -> dict:
+    """{(name, sorted-labels): value} for every counter/histogram sample
+    in a merged exposition (the series whose fleet totals must be
+    monotonic across respawns)."""
+    from tests.test_obs import parse_exposition_strict
+
+    types, samples = parse_exposition_strict(text)
+    out = {}
+    for name, labels, value in samples:
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+        if types.get(family) in ("counter", "histogram"):
+            out[(name, tuple(sorted(labels.items())))] = value
+    return out
+
+
+def test_fleet_admin_metrics_monotonic_across_sigkill_respawn(fleet):
+    """The ISSUE 13 tentpole acceptance row: the supervisor admin port
+    serves a merged strict-exposition /metrics whose counter totals
+    never go backwards across a forced worker SIGKILL + respawn, and
+    /fleetz reports the respawn (restart count, fresh pid) even while
+    the replacement is still booting (stale partial data, never a 500)."""
+    port, _, _, admin_port = fleet
+    from tests.conftest import fixture_bytes
+    from tests.test_obs import check_histograms, parse_exposition_strict
+
+    body = fixture_bytes("imaginary.jpg")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/resize?width=64", data=body,
+        headers={"Content-Type": "image/jpeg", "Connection": "close"},
+    )
+
+    def traffic(n):
+        for _ in range(n):
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+
+    # make sure both workers are up before the baseline scrape
+    end = time.monotonic() + 45
+    pids = set()
+    while time.monotonic() < end and len(pids) < 2:
+        pids |= _sample_pids(port)
+    assert len(pids) == 2
+
+    traffic(8)
+    text1 = _admin_get(admin_port, "/metrics")
+    types1, samples1 = parse_exposition_strict(text1)  # strict contract
+    check_histograms(types1, samples1)
+    v1 = _counter_series(text1)
+    assert any(n == "imaginary_tpu_requests_total" for n, _l in v1)
+
+    # force a respawn: SIGKILL whichever worker answers, then watch the
+    # supervisor's own /fleetz report the replacement
+    victim_h = _health(port)
+    victim_pid, victim_idx = victim_h["pid"], victim_h["worker"]
+    before = json.loads(_admin_get(admin_port, "/fleetz"))
+    restarts_before = before["workers"][str(victim_idx)]["restarts"]
+    epoch_before = before["workers"][str(victim_idx)]["epoch"]
+    os.kill(victim_pid, signal.SIGKILL)
+
+    end = time.monotonic() + 90
+    respawned = False
+    while time.monotonic() < end:
+        fz = json.loads(_admin_get(admin_port, "/fleetz"))
+        w = fz["workers"].get(str(victim_idx))
+        if w and w["alive"] and w["pid"] != victim_pid \
+                and w["restarts"] > restarts_before \
+                and w["epoch"] > epoch_before:
+            respawned = True
+            break
+        time.sleep(0.5)
+    assert respawned, "fleetz never reported the respawn"
+
+    # wait until the replacement actually serves again, push traffic
+    # through the whole fleet, and re-scrape
+    end = time.monotonic() + 90
+    while time.monotonic() < end:
+        if len(_sample_pids(port, n=10)) == 2:
+            break
+        time.sleep(0.5)
+    traffic(8)
+    text2 = _admin_get(admin_port, "/metrics")
+    types2, samples2 = parse_exposition_strict(text2)
+    check_histograms(types2, samples2)
+    v2 = _counter_series(text2)
+
+    # THE invariant: no counter series the fleet reported before the
+    # kill may regress after the zeroed respawn (reset correction)
+    regressions = {
+        k: (v1[k], v2[k]) for k in v1.keys() & v2.keys()
+        if v2[k] < v1[k]
+    }
+    assert not regressions, f"fleet counters went backwards: {regressions}"
+    total1 = sum(v for (n, _l), v in v1.items()
+                 if n == "imaginary_tpu_requests_total")
+    total2 = sum(v for (n, _l), v in v2.items()
+                 if n == "imaginary_tpu_requests_total")
+    assert total2 > total1  # the post-respawn traffic is in the totals
+
+
 @pytest.mark.slow
 def test_sighup_rolls_fleet_with_monotonic_epochs(fleet):
-    port, sup, fleet_path = fleet
+    port, sup, fleet_path, _ = fleet
     from tests.conftest import fixture_bytes
 
     body = fixture_bytes("imaginary.jpg")
@@ -231,7 +342,7 @@ def test_sighup_rolls_fleet_with_monotonic_epochs(fleet):
 
 def test_sigterm_drains_whole_fleet(fleet):
     # runs LAST in-module: tears the shared fleet down for real
-    port, sup, _ = fleet
+    port, sup, _, _ = fleet
     worker_pids = set()
     end = time.monotonic() + 30
     while time.monotonic() < end and len(worker_pids) < 2:
