@@ -18,15 +18,22 @@ mis-extrapolates rates from. Three pieces fix this:
   seen or the deadline lapses. There is no way to address worker k
   directly; the kernel load-balances, so we sample until coverage.
 
-* ``Aggregator`` applies monotonic counter-reset correction: a
-  per-(worker, series) high-water mark keyed by the supervisor-minted
-  fencing epoch. When a worker respawns its epoch advances (epochs are
-  fleet-monotonic, minted in run_supervisor), so the dead epoch's last
-  value is folded into a retained base and the fresh zeroed counter
-  adds on top — fleet totals never decrease. Same-epoch regressions
-  (shouldn't happen; torn scrape) are clamped with max(); scrapes from
-  an *older* epoch than the recorded one (a zombie's last gasp racing
-  its replacement) are ignored outright.
+* ``Aggregator`` applies monotonic counter-reset correction to
+  COUNTERS AND HISTOGRAMS ONLY: a per-(worker, series) high-water mark
+  keyed by the supervisor-minted fencing epoch. When a worker respawns
+  its epoch advances (epochs are fleet-monotonic, minted in
+  run_supervisor), so the dead epoch's last value is folded into a
+  retained base and the fresh zeroed counter adds on top — fleet
+  totals never decrease. Same-epoch regressions (shouldn't happen;
+  torn scrape) are clamped with max(); scrapes from an *older* epoch
+  than the recorded one (a zombie's last gasp racing its replacement)
+  are ignored outright. Gauges never enter this machinery — a gauge
+  moves both ways (queues drain, caches evict), so clamping or base
+  folding would pin it at a high-water mark and inflate it across
+  respawns; summable gauges are served as latest-snapshot sums instead.
+  ``prune`` evicts state for worker indices the supervisor no longer
+  tracks (gauges drop with the snapshot; counter contributions fold
+  into a retired base so fleet totals stay monotonic).
 
 * ``render`` re-emits a strict Prometheus 0.0.4 exposition (the PR 3
   parser in tests/test_obs.py is the contract): counters and
@@ -53,16 +60,24 @@ from urllib.parse import urlsplit
 # exposition parsing (scrape side)
 # ---------------------------------------------------------------------------
 
-# Prometheus text format 0.0.4 sample line. The optional trailing
-# " # {...} v" clause is an OpenMetrics-style exemplar (our workers only
-# attach them when asked via /metrics?exemplars=1, but tolerate them).
+# Prometheus text format 0.0.4 sample line. The label block is matched
+# as a sequence of quoted label pairs — NOT a lazy wildcard up to '}' —
+# because the format only requires escaping '"', '\' and newline inside
+# a label value, so a legal value may contain a literal '}' that a lazy
+# match would stop at, silently dropping the sample from the fleet
+# view. The optional trailing " # {...} v" clause is an
+# OpenMetrics-style exemplar (our workers only attach them when asked
+# via /metrics?exemplars=1, but tolerate them).
+_LABEL_VAL = r'(?:[^"\\]|\\["\\n]|\\\\)*'
+_LABEL_PAIR = r'[a-zA-Z_][a-zA-Z0-9_]*="' + _LABEL_VAL + '"'
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(.*?)\})?"  # non-greedy: must not eat an exemplar's braces
+    r"(?:\{((?:" + _LABEL_PAIR + r"(?:," + _LABEL_PAIR + r")*,?)?)\})?"
     r" (-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\+?Inf|NaN))"
     r"(?: # \{.*\} .*)?$"
 )
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\["\\n]|\\\\)*)"')
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="(' + _LABEL_VAL + r')"')
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
@@ -172,7 +187,9 @@ _IDENTITY_GAUGES = frozenset({
 
 
 def merge_mode(name: str, mtype: str) -> str:
-    """'sum' (reset-corrected accumulation) or 'per_worker' (labeled)."""
+    """'sum' (fleet total: reset-corrected accumulation for counters
+    and histograms, latest-snapshot sum for allowlisted gauges) or
+    'per_worker' (labeled)."""
     if mtype in ("counter", "histogram"):
         return "sum"
     if name in SUMMABLE_GAUGES:
@@ -233,8 +250,15 @@ class Aggregator:
     def __init__(self):
         self._lock = threading.Lock()
         # (worker, family, sample_key) -> [epoch, last_value, base]
-        # merged value for a summed series = base + last_value
+        # merged value for a reset-corrected series = base + last_value.
+        # Counters/histograms ONLY: a gauge moves both ways, so the
+        # monotone clamp/base-folding below would pin it at its
+        # high-water mark and inflate it across respawns — gauges
+        # (summable or not) are served straight from _last.
         self._hw: dict[tuple, list] = {}
+        # (family, sample_key) -> folded final values of PRUNED worker
+        # indices: evicting a departed worker must not regress totals
+        self._retired: dict[tuple, float] = {}
         # worker -> (epoch, families) latest full snapshot (gauges)
         self._last: dict[int, tuple] = {}
 
@@ -245,8 +269,8 @@ class Aggregator:
                 return  # a zombie's stale scrape racing its replacement
             self._last[worker] = (epoch, families)
             for fam in families.values():
-                if merge_mode(fam.name, fam.mtype) != "sum":
-                    continue
+                if fam.mtype not in ("counter", "histogram"):
+                    continue  # gauges: snapshot state, no reset correction
                 for sample_key, value in fam.samples.items():
                     hw_key = (worker, fam.name, sample_key)
                     rec = self._hw.get(hw_key)
@@ -268,17 +292,43 @@ class Aggregator:
         with self._lock:
             return {w: ef[0] for w, ef in self._last.items()}
 
+    def prune(self, tracked) -> None:
+        """Evict state for worker indices the supervisor no longer
+        tracks. Without this a departed worker's per-worker gauges
+        would re-render forever (the admin plane re-emits them fresh on
+        every scrape, so Prometheus staleness handling never kicks in)
+        and its summable-gauge contribution would sit in the fleet
+        total indefinitely. Gauges simply drop with the snapshot; a
+        reset-corrected series' contribution folds into a per-series
+        retired base so fleet counter totals stay monotonic after the
+        index disappears. Callers must stop observe()-ing a pruned
+        index (FleetAdmin filters scrapes by the supervisor view), or
+        each observe+prune cycle would re-fold its value."""
+        tracked = set(tracked)
+        with self._lock:
+            for worker in [w for w in self._last if w not in tracked]:
+                del self._last[worker]
+            for key in [k for k in self._hw if k[0] not in tracked]:
+                _worker, fam_name, sample_key = key
+                rec = self._hw.pop(key)
+                rkey = (fam_name, sample_key)
+                self._retired[rkey] = (
+                    self._retired.get(rkey, 0.0) + rec[1] + rec[2])
+
     def render(self, per_worker: bool = False, extra_gauges=None) -> str:
         """Merged strict-exposition text.
 
         per_worker=True additionally labels every *summed* series with
-        worker="k" instead of summing (debug view); the default serves
-        the fleet-total view. extra_gauges is [(name, help, value)] for
-        synthetic supervisor-side families (worker counts etc).
+        worker="k" instead of summing (debug view — pruned workers'
+        retired counter bases have no index, so they appear only in the
+        fleet-total view); the default serves the fleet-total view.
+        extra_gauges is [(name, help, value)] for synthetic
+        supervisor-side families (worker counts etc).
         """
         with self._lock:
             last = dict(self._last)
             hw = {k: list(v) for k, v in self._hw.items()}
+            retired = dict(self._retired)
 
         # family metadata: first writer wins (workers agree anyway)
         meta: dict[str, tuple] = {}
@@ -309,21 +359,41 @@ class Aggregator:
                 continue
             mtype, help_text = meta[name]
             mode = merge_mode(name, mtype)
+            corrected = mtype in ("counter", "histogram")
             merged: dict[tuple, float] = {}
-            if mode == "sum" and not per_worker:
+            if mode == "sum" and corrected and not per_worker:
                 for (worker, fam_name, sample_key), rec in hw.items():
                     if fam_name != name:
                         continue
                     merged[sample_key] = merged.get(sample_key, 0.0) \
                         + rec[2] + rec[1]
-            elif mode == "sum":
+                for (fam_name, sample_key), base in retired.items():
+                    if fam_name != name:
+                        continue
+                    merged[sample_key] = merged.get(sample_key, 0.0) + base
+            elif mode == "sum" and corrected:
                 for (worker, fam_name, sample_key), rec in hw.items():
                     if fam_name != name:
                         continue
                     sample_name, labels = sample_key
                     merged[(sample_name, labels + (("worker", str(worker)),))] \
                         = rec[2] + rec[1]
+            elif mode == "sum" and not per_worker:
+                # summable gauge: each live worker's LATEST value,
+                # summed — never the high-water table, so a queue that
+                # drains or a cache that evicts is reflected downward,
+                # and a respawn replaces (not inflates) the old value
+                for worker, (_epoch, families) in last.items():
+                    fam = families.get(name)
+                    if fam is None:
+                        continue
+                    for sample_key, value in fam.samples.items():
+                        merged[sample_key] = merged.get(sample_key, 0.0) \
+                            + value
             else:
+                # per-worker labeled straight from each latest snapshot
+                # (never-summed gauges, and every gauge in the
+                # per_worker debug view)
                 for worker, (_epoch, families) in sorted(last.items()):
                     fam = families.get(name)
                     if fam is None:
@@ -531,7 +601,13 @@ class FleetAdmin:
             per_request_timeout=self._timeout, fetch=self._fetch,
         )
         for worker, (epoch, families) in metrics_by.items():
-            self._agg.observe(worker, epoch, families)
+            # the supervisor view is authoritative: a zombie answering
+            # under an index the supervisor dropped must not resurrect
+            # its series (and must not re-fold into the retired base
+            # on every scrape)
+            if worker in view:
+                self._agg.observe(worker, epoch, families)
+        self._agg.prune(view)
         return view, health_by, missed
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:

@@ -15,7 +15,11 @@ Config is JSON, inline or a file path (same convention as
      "*":       {"latency_ms": 500, "latency_target": 0.95,
                  "availability": 0.99}}
 
-``*`` is the catch-all for routes without their own entry. A request
+``*`` is the catch-all for routes without their own entry — except the
+observability plane's own routes (/health, /metrics, /debugz*), which
+only count when given an explicit entry (see INFRA_ROUTE_SUFFIXES: the
+supervisor's liveness probes would otherwise dilute burn rates with
+guaranteed-fast 200s). A request
 counts against availability when its status is 5xx, and against the
 latency objective when it ran longer than ``latency_ms``. Burn rate is
 ``bad_fraction / (1 - target)`` over the window; ``budget_remaining``
@@ -49,6 +53,23 @@ SLO_METRICS = (
 )
 
 WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+# Observability/liveness-plane routes the trace middleware sees but
+# users never call: the supervisor's liveness probe alone lands ~0.5
+# rps of guaranteed-fast 200s per worker on /health, and Prometheus
+# scrapes /metrics — folding those into a "*" catch-all dilutes
+# availability/latency burn rates for real traffic. Matched as path
+# SUFFIXES so a --path-prefix deployment is covered too. An EXPLICIT
+# objective for one of these routes still applies; only the catch-all
+# skips them.
+INFRA_ROUTE_SUFFIXES = (
+    "/health", "/metrics", "/debugz", "/debugz/profile",
+    "/debugz/failpoints",
+)
+
+
+def is_infra_route(route: str) -> bool:
+    return route.endswith(INFRA_ROUTE_SUFFIXES)
 
 _RING_MIN_INTERVAL_S = 5.0
 _RING_RETAIN_S = 3700.0  # 1h window + slack
@@ -124,7 +145,12 @@ class SloEngine:
         self._t0 = clock()
 
     def _objective_for(self, route: str):
-        return self.objectives.get(route) or self.objectives.get("*")
+        obj = self.objectives.get(route)
+        if obj is not None:
+            return obj
+        if is_infra_route(route):
+            return None  # probes/scrapes don't dilute the catch-all
+        return self.objectives.get("*")
 
     def observe(self, route: str, status: int, elapsed_s: float) -> None:
         obj = self._objective_for(route)

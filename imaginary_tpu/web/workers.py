@@ -171,6 +171,26 @@ def _open_health(health_url: str, timeout_s: float, ctx=None):
         return json.loads(r.read())
 
 
+def metrics_url_for(health_url: str) -> str:
+    """Derive the fleet /metrics scrape target from the /health probe
+    URL by swapping the terminal path segment — on the parsed path
+    component, not by blind suffix slicing of the whole URL, so a
+    query string can't corrupt it and a probe URL whose path doesn't
+    end in /health fails loudly at boot instead of leaving the admin
+    plane silently scraping garbage (every worker reported as missed).
+    The path prefix (--path-prefix) is preserved."""
+    from urllib.parse import urlsplit, urlunsplit
+
+    parts = urlsplit(health_url)
+    if not parts.path.endswith("/health"):
+        raise ValueError(
+            f"cannot derive fleet /metrics URL from {health_url!r}: "
+            "path does not end with /health")
+    path = parts.path[: -len("/health")] + "/metrics"
+    return urlunsplit(
+        (parts.scheme, parts.netloc, path, parts.query, parts.fragment))
+
+
 def _ssl_ctx_for(health_url: str):
     if not health_url.startswith("https:"):
         return None
@@ -380,7 +400,7 @@ def run_supervisor(argv: list, workers: int, health_url: str = "",
         # the admin's request threads while this loop mutates them.
         from imaginary_tpu.obs.aggregate import FleetAdmin
 
-        metrics_url = health_url[: -len("/health")] + "/metrics"
+        metrics_url = metrics_url_for(health_url)
         _admin_ctx = _ssl_ctx_for(health_url)
 
         def _admin_fetch(url: str, timeout: float) -> str:

@@ -104,6 +104,21 @@ class TestParseExposition:
         hist = fams["imaginary_tpu_request_duration_seconds"]
         assert list(hist.samples.values()) == [8.0]
 
+    def test_label_value_containing_brace(self):
+        # Prometheus only requires escaping '"', '\' and newline in a
+        # label value, so a literal '}' (think templated route labels)
+        # is legal and must not truncate the label block
+        text = (
+            "# TYPE imaginary_tpu_requests_total counter\n"
+            'imaginary_tpu_requests_total'
+            '{route="/v1/{spec}/resize",code="2xx"} 3\n'
+        )
+        fams = parse_exposition(text)
+        red = fams["imaginary_tpu_requests_total"]
+        ((name, labels),) = list(red.samples)
+        assert dict(labels) == {"route": "/v1/{spec}/resize", "code": "2xx"}
+        assert red.samples[(name, labels)] == 3.0
+
 
 class TestMergeMode:
     def test_counters_and_histograms_sum(self):
@@ -179,6 +194,102 @@ class TestAggregatorMonotonicity:
         total = next(v for n, _l, v in samples
                      if n == "imaginary_tpu_requests_total")
         assert total == 10.0
+
+
+class TestSummableGaugeSnapshots:
+    """Summable gauges are latest-snapshot sums, never reset-corrected:
+    the counter machinery's max() clamp and base folding would pin a
+    draining queue at its high-water mark and inflate fleet totals on
+    every respawn."""
+
+    def _gauge(self, agg, name="imaginary_tpu_threads"):
+        _, samples = parse_exposition_strict(agg.render())
+        return next(v for n, _l, v in samples if n == name)
+
+    def test_gauge_decrease_tracks_snapshot(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(
+            worker_exposition(0, 1, 10, 8, threads=9)))
+        agg.observe(1, 2, parse_exposition(
+            worker_exposition(1, 2, 10, 8, threads=7)))
+        assert self._gauge(agg) == 16.0
+        # worker 0's pool shrinks: the fleet total must follow DOWN
+        agg.observe(0, 1, parse_exposition(
+            worker_exposition(0, 1, 12, 9, threads=3)))
+        assert self._gauge(agg) == 10.0
+
+    def test_gauge_not_inflated_across_respawn(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(
+            worker_exposition(0, 1, 10, 8, threads=9)))
+        # respawn (epoch 1 -> 4): the new incarnation's gauge REPLACES
+        # the dead one's — no permanent base from the old value
+        agg.observe(0, 4, parse_exposition(
+            worker_exposition(0, 4, 0, 0, threads=5)))
+        assert self._gauge(agg) == 5.0
+        # ...while the counter DID fold the dead incarnation's total
+        _, samples = parse_exposition_strict(agg.render())
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 10.0
+
+    def test_per_worker_view_serves_snapshots_too(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(
+            worker_exposition(0, 1, 10, 8, threads=9)))
+        agg.observe(0, 1, parse_exposition(
+            worker_exposition(0, 1, 11, 9, threads=2)))
+        _, samples = parse_exposition_strict(agg.render(per_worker=True))
+        threads = {labels["worker"]: v for n, labels, v in samples
+                   if n == "imaginary_tpu_threads"}
+        assert threads == {"0": 2.0}
+
+
+class TestPrune:
+    def _agg(self):
+        agg = Aggregator()
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 100, 80)))
+        agg.observe(1, 2, parse_exposition(worker_exposition(1, 2, 40, 30)))
+        return agg
+
+    def test_departed_worker_state_evicted(self):
+        agg = self._agg()
+        agg.prune({0})
+        assert agg.workers_seen() == {0: 1}
+        _, samples = parse_exposition_strict(agg.render())
+        # per-worker series for the departed index are gone
+        assert {labels["worker"] for n, labels, _v in samples
+                if n == "imaginary_tpu_rss_mb"} == {"0"}
+        # its summable-gauge contribution drops out of the fleet total
+        threads = next(v for n, _l, v in samples
+                       if n == "imaginary_tpu_threads")
+        assert threads == 7.0
+        # but counter totals stay monotonic: the retired index's final
+        # value folds into a per-series base
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 140.0
+        count = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_request_duration_seconds_count")
+        assert count == 140.0
+
+    def test_retired_base_survives_later_observes(self):
+        agg = self._agg()
+        agg.prune({0})
+        agg.observe(0, 1, parse_exposition(worker_exposition(0, 1, 107, 85)))
+        _, samples = parse_exposition_strict(agg.render())
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 147.0
+
+    def test_prune_noop_when_all_tracked(self):
+        agg = self._agg()
+        agg.prune({0, 1})
+        _, samples = parse_exposition_strict(agg.render())
+        total = next(v for n, _l, v in samples
+                     if n == "imaginary_tpu_requests_total")
+        assert total == 140.0
+        assert agg.workers_seen() == {0: 1, 1: 2}
 
 
 class TestMergedRender:
@@ -380,6 +491,41 @@ class TestFleetAdminHTTP:
     def test_unknown_path_404(self, admin):
         status, _ = _get(admin.port, "/nope")
         assert status == 404
+
+    def test_scaled_down_worker_evicted_but_totals_hold(self):
+        # the supervisor stops tracking index 1 between two admin
+        # requests; its zombie keeps answering the shared port. The
+        # merged view must drop its gauges (no stale series forever)
+        # without regressing fleet counter totals — and without
+        # re-folding the zombie's answers into the base every scrape.
+        fetch = round_robin_fetch({
+            "metrics": [worker_exposition(0, 1, 100, 80),
+                        worker_exposition(1, 2, 40, 30)],
+            "health": [health_body(0, 1), health_body(1, 2)],
+        })
+        tracked = {0: {"pid": 11, "alive": True, "epoch": 1, "restarts": 0},
+                   1: {"pid": 12, "alive": True, "epoch": 2, "restarts": 1}}
+
+        srv = FleetAdmin(0, "http://shared/metrics", "http://shared/health",
+                         lambda: dict(tracked), scrape_deadline_s=1.0,
+                         fetch=fetch).start()
+        try:
+            _, text = _get(srv.port, "/metrics")
+            _, samples = parse_exposition_strict(text)
+            assert next(v for n, _l, v in samples
+                        if n == "imaginary_tpu_requests_total") == 140.0
+            del tracked[1]
+            for _ in range(2):  # two scrapes: retired base must not grow
+                _, text = _get(srv.port, "/metrics")
+            _, samples = parse_exposition_strict(text)
+            assert {labels["worker"] for n, labels, _v in samples
+                    if n == "imaginary_tpu_rss_mb"} == {"0"}
+            assert next(v for n, _l, v in samples
+                        if n == "imaginary_tpu_threads") == 7.0
+            assert next(v for n, _l, v in samples
+                        if n == "imaginary_tpu_requests_total") == 140.0
+        finally:
+            srv.close()
 
     def test_totals_monotonic_across_admin_requests(self, admin):
         # the persistent Aggregator means a second scrape that catches a

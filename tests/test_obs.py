@@ -781,6 +781,31 @@ class TestSloEngine:
         eng.observe("/other", 500, 0.01)
         assert eng.snapshot()["routes"] == {}
 
+    def test_infra_routes_excluded_from_catchall(self):
+        # the supervisor's liveness probes land ~0.5 rps of fast 200s
+        # per worker on /health; a '*' objective must not let that
+        # traffic dilute burn rates for real routes
+        from imaginary_tpu.obs import slo as slo_mod
+
+        eng = slo_mod.SloEngine(
+            slo_mod.load_config('{"*": {"availability": 0.999}}'))
+        for route in ("/health", "/metrics", "/debugz",
+                      "/api/health", "/api/metrics"):
+            eng.observe(route, 200, 0.001)
+        eng.observe("/resize", 500, 0.01)
+        routes = eng.snapshot()["routes"]
+        assert set(routes) == {"/resize"}
+        assert routes["/resize"]["availability"]["bad_5m"] == 1
+        assert routes["/resize"]["availability"]["total_5m"] == 1
+
+    def test_explicit_infra_objective_still_applies(self):
+        from imaginary_tpu.obs import slo as slo_mod
+
+        eng = slo_mod.SloEngine(slo_mod.load_config(
+            '{"/health": {"availability": 0.999}}'))
+        eng.observe("/health", 200, 0.001)
+        assert eng.snapshot()["routes"]["/health"]["total"] == 1
+
     def test_from_options_parity_off(self):
         from imaginary_tpu.obs import slo as slo_mod
 

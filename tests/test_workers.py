@@ -355,6 +355,20 @@ def test_sigterm_drains_whole_fleet(fleet):
             os.kill(pid, 0)  # ESRCH: worker really exited
 
 
+def test_metrics_url_for():
+    from imaginary_tpu.web.workers import metrics_url_for
+
+    assert metrics_url_for("http://127.0.0.1:8080/health") \
+        == "http://127.0.0.1:8080/metrics"
+    # --path-prefix survives, and only the PATH component is rewritten
+    assert metrics_url_for("https://127.0.0.1:8443/api/v1/health") \
+        == "https://127.0.0.1:8443/api/v1/metrics"
+    # a probe URL that can't yield a /metrics sibling fails at boot,
+    # not as an admin plane silently scraping garbage
+    with pytest.raises(ValueError):
+        metrics_url_for("http://127.0.0.1:8080/healthz")
+
+
 def test_worker_index_helper():
     from imaginary_tpu.web.workers import WORKER_ENV, worker_index
 
