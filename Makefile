@@ -116,9 +116,17 @@ bench-qos:
 # forced-device batch-policy A/B (convoy vs continuous) on this host's
 # backend: exits nonzero when the continuous policy's batch_form +
 # dispatch_wait p50 exceeds 25% of the convoy queue_wait p50, when
-# throughput regresses, or when any arm pays a post-prewarm compile
+# throughput regresses, or when any arm pays a post-prewarm compile.
+# Second invocation: raw-vs-dct transport A/B under a measured-link sim
+# (BENCH_LINK_FIXED_MS / BENCH_LINK_MB_PER_S pace the staged bytes read
+# off the wire ledger); exits nonzero when the dct arm's wire bytes are
+# not >=4x below raw on the 1080p->thumbnail ladder, when either arm
+# pays a post-prewarm compile, or when the measured-wire projection's
+# tunnel_measured dct row stays link-bound. Rows archive to
+# artifacts/transport_ab_<backend>.jsonl.
 bench-device:
 	BENCH_AB=1 BENCH_PLATFORM=cpu python bench_device.py
+	BENCH_TRANSPORT_AB=1 BENCH_PLATFORM=cpu python bench_device.py
 
 # bomb + oversize-enlarge firehose, governor on vs off: the governed arm
 # must hold >=95% well-formed availability (only 200/413/503/504) with

@@ -289,24 +289,203 @@ def policy_ab() -> int:
 # or delete on a loss"). The einsum path in ops/stages.py carries the note.
 
 
+def transport_ab():
+    """Raw-vs-compressed-domain transport A/B on the 1080p -> thumbnail
+    ladder, under the measured-link simulation (BENCH_LINK_FIXED_MS per
+    drain, default 60 — the tunnel's measured floor — plus byte pacing at
+    BENCH_LINK_MB_PER_S, default 30). The pacing reads the WIRE ledger's
+    own deltas around every launch/drain, so the simulated link prices
+    exactly the bytes the serving path measured itself moving — a
+    transport that cheats the ledger cheats its own pacing.
+
+    Workload: BENCH_SOURCES distinct synthetic 1080p 4:2:0 JPEGs, each
+    requested BENCH_TRANSPORT_REPEATS times (default 40 — the hot-source shape a
+    thumbnail fleet actually serves). The raw arm is the incumbent path
+    (packed YUV420 where the native codec exists, RGB otherwise); the dct
+    arm enables --transport-dct plus the device frame cache, so repeat
+    requests stage zero H2D bytes. Note the cold dct stage is ~4x the raw
+    bytes per image (int16 x 3 channels vs packed-u8 YUV420): the entire
+    wire win is the hot-hit amortization, which is why the gate needs a
+    genuinely hot workload — at 40 repeats the geometry puts the total
+    raw/dct ratio at ~4.7x against the >=4x gate, converging toward the
+    ~7.9x d2h-only asymptote.
+
+    Gates (exit nonzero on violation):
+      * total wire bytes (h2d + d2h) raw/dct >= 4x;
+      * compile_misses == 0 in BOTH arms after each arm's own prewarm;
+      * with the measured wire bytes, link_projection's tunnel_measured
+        dct row at 1 host core is no longer link-bound (the bound flips
+        to the chip or the host codecs).
+
+    Returns (rows, exit_code); the caller archives rows and feeds them to
+    link_projection.
+    """
+    import hashlib
+    import io
+
+    from PIL import Image
+
+    from imaginary_tpu import pipeline as pipeline_mod
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.cache import CacheSet, DeviceFrameCache, FrameCache
+    from imaginary_tpu.codecs import jpeg_dct
+    from imaginary_tpu.engine.executor import (Executor, ExecutorConfig,
+                                               batch_ladder)
+    from imaginary_tpu.engine.timing import WIRE
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops import chain as chain_mod
+
+    fixed_s = float(os.environ.get("BENCH_LINK_FIXED_MS", "60")) / 1000.0
+    bw = float(os.environ.get("BENCH_LINK_MB_PER_S", "30")) * 1e6
+    n_sources = int(os.environ.get("BENCH_SOURCES", "4"))
+    repeats = int(os.environ.get("BENCH_TRANSPORT_REPEATS", "40"))
+
+    # synthetic 1080p corpus: smooth upsampled content — random noise
+    # would defeat both JPEG entropy coding and the DCT sparsity, pricing
+    # a workload no image service ever serves
+    rng = np.random.default_rng(5)
+    bufs = []
+    for _ in range(n_sources):
+        small = rng.integers(0, 256, (68, 120, 3), dtype=np.uint8)
+        im = Image.fromarray(small).resize((1920, 1080), Image.BILINEAR)
+        b = io.BytesIO()
+        im.save(b, "JPEG", quality=85, subsampling=2)
+        bufs.append(b.getvalue())
+    o = ImageOptions(width=100)
+
+    # cold entropy-decode cost (the dct arm's host-side price on a
+    # frame-cache miss; the projection amortizes it over the hit rate)
+    t0 = time.perf_counter()
+    assert jpeg_dct.decode_packed(bufs[0], 8) is not None
+    entropy_ms = (time.perf_counter() - t0) * 1000.0
+
+    real_launch, real_fetch = chain_mod.launch_batch, chain_mod.fetch_groups
+
+    def paced_launch(arrs, plans, **kw):
+        b0 = WIRE.snapshot()["h2d"]
+        y = real_launch(arrs, plans, **kw)
+        time.sleep((WIRE.snapshot()["h2d"] - b0) / bw)
+        return y
+
+    def paced_fetch(ys):
+        b0 = WIRE.snapshot()["d2h"]
+        out = real_fetch(ys)
+        time.sleep(fixed_s + (WIRE.snapshot()["d2h"] - b0) / bw)
+        return out
+
+    def run_arm(use_dct: bool) -> dict:
+        pipeline_mod.set_transport_dct(use_dct)
+        cs = CacheSet(frame_mb=64.0, device_mb=64.0 if use_dct else 0.0)
+        fc = FrameCache(cs.frames, cs.stats)
+        chain_mod.set_device_frame_cache(
+            DeviceFrameCache(cs.device, cs.stats) if use_dct else None)
+        built = prewarm.warm_chain("thumbnail", o, 1080, 1920,
+                                   batch_ladder())
+        ex = Executor(ExecutorConfig(host_spill=False))
+        w0 = WIRE.snapshot()
+        chain_mod.launch_batch = paced_launch
+        chain_mod.fetch_groups = paced_fetch
+        t_arm = time.perf_counter()
+        try:
+            for _ in range(repeats):
+                for buf in bufs:
+                    digest = hashlib.sha256(buf).hexdigest()
+                    out = pipeline_mod.process_operation(
+                        "thumbnail", buf, o, runner=ex.process,
+                        frame_cache=fc, source_digest=digest)
+                    assert out.mime == "image/jpeg"
+        finally:
+            chain_mod.launch_batch = real_launch
+            chain_mod.fetch_groups = real_fetch
+        elapsed = time.perf_counter() - t_arm
+        misses = ex.stats.compile_misses
+        ex.shutdown()
+        w1 = WIRE.snapshot()
+        n = repeats * len(bufs)
+        h2d = w1["h2d"] - w0["h2d"]
+        d2h = w1["d2h"] - w0["d2h"]
+        arm = {
+            "transport": "dct" if use_dct else "raw",
+            "requests": n,
+            "prewarmed": built,
+            "wire_h2d_bytes": h2d,
+            "wire_d2h_bytes": d2h,
+            "wire_mb_per_img": round((h2d + d2h) / n / 1e6, 6),
+            "req_per_s_paced": round(n / elapsed, 1),
+            "compile_misses": misses,
+            "device_cache_hits": cs.stats.device_hits,
+            "device_cache_misses": cs.stats.device_misses,
+        }
+        if use_dct:
+            # entropy decode runs once per cache-cold source; per-request
+            # host cost amortizes over the hot hit rate
+            arm["entropy_decode_ms"] = round(entropy_ms, 1)
+            arm["host_ms_per_img"] = round(entropy_ms * len(bufs) / n, 2)
+        pipeline_mod.set_transport_dct(False)
+        chain_mod.set_device_frame_cache(None)
+        log(f"[dev] transport {arm['transport']:>3}: "
+            f"{arm['wire_mb_per_img'] * 1000:.1f} kB/img on the wire "
+            f"(h2d {h2d} d2h {d2h}), {arm['req_per_s_paced']} req/s paced, "
+            f"{misses} compile misses")
+        return arm
+
+    raw = run_arm(False)
+    dct = run_arm(True)
+    reduction = ((raw["wire_h2d_bytes"] + raw["wire_d2h_bytes"]) /
+                 max(1, dct["wire_h2d_bytes"] + dct["wire_d2h_bytes"]))
+    ok = True
+    why = []
+    if reduction < 4.0:
+        ok = False
+        why.append(f"wire reduction {reduction:.2f}x < 4x")
+    for arm in (raw, dct):
+        if arm["compile_misses"] != 0:
+            ok = False
+            why.append(f"{arm['transport']} paid {arm['compile_misses']} "
+                       "post-prewarm compiles")
+    row = {
+        "metric": "transport_ab_thumbnail_1080p",
+        "link_fixed_ms": fixed_s * 1000.0,
+        "link_mb_per_s": bw / 1e6,
+        "arms": [raw, dct],
+        "wire_reduction": round(reduction, 2),
+        "ok": ok,
+    }
+    print(json.dumps(row), flush=True)
+    if ok:
+        log(f"[dev] transport A/B ok: {reduction:.1f}x fewer wire bytes, "
+            "zero compile misses in both arms")
+    else:
+        log(f"[dev] *** transport A/B FAILED: {'; '.join(why)} ***")
+    return [row], (0 if ok else 1)
+
+
 def link_projection(live_rows=None) -> list:
     """Co-located-link projection (VERDICT r4 next #1b): bridge the
     measured on-chip rate to projected END-TO-END serving throughput per
     link class, so "Nx on co-located hardware" is an evidenced
     extrapolation instead of a hope.
 
-    Per-image wire bytes are computed from the REAL serving-path bucket
-    math (shrink-on-load decode of the 1080p headline workload, packed
-    YUV420 both ways — codecs/__init__.py layout). The on-chip rate
-    comes from live measurement when a chip is present, else from the
-    committed r4 hardware artifact. Link bandwidth/fixed-cost pairs are
-    labeled assumptions spanning the measured tunnel to co-located PCIe.
+    Per-image wire bytes per TRANSPORT: measured from the transport A/B's
+    WIRE ledger (live rows first, then the archived artifact) whenever a
+    measurement exists, else the static packed-layout bucket math — each
+    row says which it used (`wire_src`). The on-chip rate comes from live
+    measurement when a chip is present, else from the committed r4
+    hardware artifact. Link bandwidth/fixed-cost pairs are labeled
+    assumptions spanning the measured tunnel to co-located PCIe.
 
         projected req/s = min(link rate, chip rate, host codec rate)
         link rate  = 1 / (fixed_ms/batch + bytes/bandwidth)
         host rate  = cores / host_fixed_ms   (decode+encode, measured)
+
+    The raw transport's tunnel rows are link-bound — that is the finding
+    that motivated compressed-domain ingest. The dct rows price the
+    hot-source steady state (device frame cache pins staged inputs, so
+    H2D amortizes to ~0) but also carry the pure-Python entropy decode in
+    their host column, amortized over the measured hot hit rate: the
+    tunnel bound flips from the link to the chip or the host codecs.
     """
-    from imaginary_tpu.ops.buckets import bucket_shape
+    from imaginary_tpu.ops.buckets import bucket_shape, dct_packed_geometry
 
     # headline workload: 1080p JPEG -> /resize 300x200. The serving path
     # decodes at 1/4 via DCT scaling (choose_decode_shrink) -> 270x480.
@@ -357,6 +536,47 @@ def link_projection(live_rows=None) -> list:
             break
         except (OSError, KeyError, ValueError):
             continue
+    # per-transport wire + host columns. Static fallbacks first:
+    #   yuv420 — packed planes both ways (the incumbent math above);
+    #   dct    — hot-source steady state: H2D amortizes to ~0 through the
+    #            device frame cache, the packed-yuv output still drains,
+    #            and the host pays the measured-class pure-Python entropy
+    #            decode on every cache-cold source (static: the measured
+    #            ~450 ms on a 1080p stream, amortized at a 1-in-40 miss
+    #            rate — the A/B workload's shape).
+    k, _, _, hb_d, wb_d = dct_packed_geometry(1080, 1920, 4)
+    transports = {
+        "yuv420": {"wire_mb": wire_mb, "host_ms": host_fixed_ms,
+                   "wire_src": "static-packed-math"},
+        "dct": {"wire_mb": (hb_d * wb_d * 3 * 2 / 40 + bytes_out) / 1e6,
+                "host_ms": host_fixed_ms + 450.0 / 40,
+                "wire_src": "static-packed-math"},
+    }
+    # measured override: the transport A/B row's ledger numbers (live
+    # rows first, then the archived artifact)
+    ab_rows = [r for r in rows if r.get("metric") == "transport_ab_thumbnail_1080p"]
+    if not ab_rows:
+        import glob
+
+        for path in sorted(glob.glob(os.path.join("artifacts", "transport_ab_*.jsonl"))):
+            try:
+                with open(path) as f:
+                    for line in f:
+                        r = json.loads(line)
+                        if r.get("metric") == "transport_ab_thumbnail_1080p":
+                            ab_rows.append(r)
+            except (OSError, ValueError):
+                continue
+    for r in ab_rows:
+        for arm in r.get("arms", []):
+            name = "dct" if arm.get("transport") == "dct" else "yuv420"
+            t = transports[name]
+            if arm.get("wire_mb_per_img", 0) > 0:
+                t["wire_mb"] = arm["wire_mb_per_img"]
+                t["wire_src"] = "transport_ab_measured"
+            if arm.get("host_ms_per_img", 0) > 0:
+                t["host_ms"] = host_fixed_ms + arm["host_ms_per_img"]
+
     links = [
         # (label, MB/s, fixed ms per drain) — tunnel numbers are MEASURED
         ("tunnel_measured", 30.0, 60.0),
@@ -366,30 +586,34 @@ def link_projection(live_rows=None) -> list:
     ]
     out = []
     serving_batch = 16
-    for label, mbps, fixed_ms in links:
-        link_rate = 1000.0 / (fixed_ms / serving_batch + wire_mb / mbps * 1000.0)
-        for cores in (1, 8, 32):
-            host_rate = cores * 1000.0 / host_fixed_ms
-            e2e = min(link_rate, chip_rate, host_rate)
-            bound = ("link" if e2e == link_rate
-                     else "chip" if e2e == chip_rate else "host-codecs")
-            row = {
-                "metric": "link_projection_resize_1080p",
-                "link": label,
-                "link_mb_per_s": mbps,
-                "drain_fixed_ms": fixed_ms,
-                "host_cores": cores,
-                "wire_mb_per_img": round(wire_mb, 4),
-                "chip_imgs_per_s": round(chip_rate, 1),
-                "chip_rate_source": src,
-                "projected_req_per_s": round(e2e, 1),
-                "bound_by": bound,
-                "vs_1core_cv2_baseline": round(e2e / (1000.0 / base_ms), 2),
-            }
-            out.append(row)
-            log(f"[dev] proj {label:>16} cores={cores:<3} -> "
-                f"{row['projected_req_per_s']:>8} req/s ({bound})")
-            print(json.dumps(row), flush=True)
+    for transport, t in transports.items():
+        for label, mbps, fixed_ms in links:
+            link_rate = 1000.0 / (fixed_ms / serving_batch
+                                  + t["wire_mb"] / mbps * 1000.0)
+            for cores in (1, 8, 32):
+                host_rate = cores * 1000.0 / t["host_ms"]
+                e2e = min(link_rate, chip_rate, host_rate)
+                bound = ("link" if e2e == link_rate
+                         else "chip" if e2e == chip_rate else "host-codecs")
+                row = {
+                    "metric": "link_projection_resize_1080p",
+                    "transport": transport,
+                    "link": label,
+                    "link_mb_per_s": mbps,
+                    "drain_fixed_ms": fixed_ms,
+                    "host_cores": cores,
+                    "wire_mb_per_img": round(t["wire_mb"], 4),
+                    "wire_src": t["wire_src"],
+                    "chip_imgs_per_s": round(chip_rate, 1),
+                    "chip_rate_source": src,
+                    "projected_req_per_s": round(e2e, 1),
+                    "bound_by": bound,
+                    "vs_1core_cv2_baseline": round(e2e / (1000.0 / base_ms), 2),
+                }
+                out.append(row)
+                log(f"[dev] proj {transport:>6} {label:>16} cores={cores:<3} -> "
+                    f"{row['projected_req_per_s']:>8} req/s ({bound})")
+                print(json.dumps(row), flush=True)
     return out
 
 
@@ -415,6 +639,31 @@ def main():
 
     log(f"[dev] backend={jax.default_backend()} devices={len(jax.devices())} "
         f"reps={REPS}")
+
+    if os.environ.get("BENCH_TRANSPORT_AB") == "1":
+        # raw-vs-dct transport A/B (the second make bench-device gate
+        # row): measured wire bytes + paced-link throughput, archived,
+        # then the projection re-run with the measured numbers — and the
+        # tunnel-row bound flip gated
+        rows, code = transport_ab()
+        os.makedirs("artifacts", exist_ok=True)
+        art = os.path.join("artifacts",
+                           f"transport_ab_{jax.default_backend()}.jsonl")
+        proj = link_projection(rows)
+        with open(art, "w") as f:
+            for r in rows + proj:
+                f.write(json.dumps(r) + "\n")
+        log(f"[dev] archived transport A/B + projection -> {art}")
+        flip = [r for r in proj
+                if r["transport"] == "dct" and r["link"] == "tunnel_measured"
+                and r["host_cores"] == 1 and r["wire_src"] == "transport_ab_measured"]
+        if not flip or flip[0]["bound_by"] == "link":
+            log("[dev] *** transport A/B FAILED: tunnel_measured dct row "
+                "still link-bound with measured wire bytes ***")
+            return 1
+        log(f"[dev] tunnel bound flipped: link -> {flip[0]['bound_by']} "
+            f"at {flip[0]['wire_mb_per_img']} MB/img measured")
+        return code
 
     if os.environ.get("BENCH_AB") == "1":
         # batch-policy A/B only (the make bench-device gate row): convoy

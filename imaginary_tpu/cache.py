@@ -54,6 +54,9 @@ class CacheStats:
     frame_hits: int = 0
     frame_misses: int = 0
     frame_evictions: int = 0
+    device_hits: int = 0
+    device_misses: int = 0
+    device_evictions: int = 0
     source_hits: int = 0
     source_misses: int = 0
     source_evictions: int = 0
@@ -260,7 +263,7 @@ class CacheSet:
 
     def __init__(self, result_mb: float = 0.0, frame_mb: float = 0.0,
                  coalesce: bool = False, source_ttl_s: float = 0.0,
-                 source_mb: float = 32.0):
+                 source_mb: float = 32.0, device_mb: float = 0.0):
         self.stats = CacheStats()
         s = self.stats
 
@@ -273,6 +276,13 @@ class CacheSet:
                                     on_evict=_ev("result_evictions"))
         self.frames = ByteBudgetLRU(int(frame_mb * 1e6),
                                     on_evict=_ev("frame_evictions"))
+        # device-resident packed-frame tier (dct/yuv transport inputs
+        # staged once, reused across requests — ops/chain consults it via
+        # the DeviceFrameCache facade). Values are jax device arrays, so
+        # the byte budget is chargeable HBM: eviction drops the last
+        # reference and the runtime frees the buffer.
+        self.device = ByteBudgetLRU(int(device_mb * 1e6),
+                                    on_evict=_ev("device_evictions"))
         self.source = ByteBudgetLRU(
             int(source_mb * 1e6) if source_ttl_s > 0 else 0,
             ttl_s=source_ttl_s, on_evict=_ev("source_evictions"))
@@ -288,7 +298,7 @@ class CacheSet:
         # pristine budgets, restored when pressure recedes (the brownout
         # ladder below mutates the live ones)
         self._base_budgets = (self.result.budget, self.frames.budget,
-                              self.source.budget)
+                              self.source.budget, self.device.budget)
         self._pressure_level = 0
 
     def apply_pressure(self, level: int) -> None:
@@ -297,25 +307,32 @@ class CacheSet:
         budgets halve — cache hits are cheap to re-earn, resident cache
         bytes are exactly the RSS the governor is trying to reclaim.
         Critical: quarter budgets and DISABLE the remote-source cache
-        (whole encoded bodies, the largest entries per hit). Level ok
+        (whole encoded bodies, the largest entries per hit). The device
+        frame tier shrinks on the same rungs but disables entirely at
+        critical: its bytes are resident HBM next to the compiled
+        programs and batch buffers the executor needs to keep serving,
+        so it is the first tier to give everything back. Level ok
         restores the configured budgets; entries evicted under pressure
         simply miss and re-fill."""
         if level == self._pressure_level:
             return
         self._pressure_level = level
-        result_b, frame_b, source_b = self._base_budgets
+        result_b, frame_b, source_b, device_b = self._base_budgets
         if level >= 2:
             self.result.set_budget(result_b // 4)
             self.frames.set_budget(frame_b // 4)
             self.source.set_budget(0)
+            self.device.set_budget(0)
         elif level == 1:
             self.result.set_budget(result_b // 2)
             self.frames.set_budget(frame_b // 2)
             self.source.set_budget(source_b)
+            self.device.set_budget(device_b // 2)
         else:
             self.result.set_budget(result_b)
             self.frames.set_budget(frame_b)
             self.source.set_budget(source_b)
+            self.device.set_budget(device_b)
         if level > 0:
             self.stats.pressure_shrinks += 1
 
@@ -327,6 +344,7 @@ class CacheSet:
             coalesce=getattr(o, "cache_coalesce", False),
             source_ttl_s=getattr(o, "cache_source_ttl", 0.0),
             source_mb=getattr(o, "cache_source_mb", 32.0),
+            device_mb=getattr(o, "cache_device_mb", 0.0),
         )
 
     def attach_shm(self, shm) -> None:
@@ -396,6 +414,11 @@ class CacheSet:
             "frame_evictions": s.frame_evictions,
             "frame_items": len(self.frames),
             "frame_bytes": self.frames.bytes_used,
+            "device_hits": s.device_hits,
+            "device_misses": s.device_misses,
+            "device_evictions": s.device_evictions,
+            "device_items": len(self.device),
+            "device_bytes": self.device.bytes_used,
             "source_hits": s.source_hits,
             "source_misses": s.source_misses,
             "source_evictions": s.source_evictions,
@@ -436,3 +459,48 @@ class FrameCache:
 
     def put(self, key, value, nbytes: int) -> None:
         self._lru.put(key, value, nbytes)
+
+
+class DeviceFrameCache:
+    """Device-resident packed-frame tier facade registered with
+    ops/chain.set_device_frame_cache. Keys are the plan's frame_key
+    (digest, shrink, transport, packed dims); values are staged jax device
+    arrays. A hit makes the batch's H2D transfer for that item zero wire
+    bytes — repeat requests against a hot source reuse resident HBM, which
+    is the compressed-domain ingest plane's biggest link win. Size is
+    charged as the host buffer's nbytes (identical layout device-side);
+    eviction drops the last reference and the runtime frees the buffer.
+    Budget rides CacheSet.apply_pressure's brownout ladder (halved at
+    elevated, disabled + drained at critical)."""
+
+    def __init__(self, lru: ByteBudgetLRU, stats: CacheStats):
+        self._lru = lru
+        self._stats = stats
+
+    @property
+    def enabled(self) -> bool:
+        return self._lru.enabled
+
+    @property
+    def bytes_used(self) -> int:
+        return self._lru.bytes_used
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def get(self, key):
+        try:
+            got = self._lru.get(key)
+        except Exception:
+            got = None  # failing tier reads as a miss (see ByteBudgetLRU.get)
+        if got is None:
+            self._stats.device_misses += 1
+        else:
+            self._stats.device_hits += 1
+        return got
+
+    def put(self, key, value, nbytes: int) -> None:
+        self._lru.put(key, value, nbytes)
+
+    def clear(self) -> None:
+        self._lru.clear()

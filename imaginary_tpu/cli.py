@@ -426,6 +426,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prewarm", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_PREWARM"),
                    help="pre-compile common op chains")
+    p.add_argument("--transport-dct", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_TRANSPORT_DCT"),
+                   help="serve 4:2:0 JPEG requests over the compressed-"
+                        "domain transport: host entropy decode ships DCT "
+                        "coefficients, the device runs the IDCT, and "
+                        "shrink-on-load folds in the DCT domain")
     # content-addressed caching (imaginary_tpu/cache.py); every knob also
     # honors an IMAGINARY_TPU_CACHE_* env override and defaults OFF so the
     # uncached serving path stays byte-identical to the reference build
@@ -436,6 +442,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-frame-mb", type=float,
                    default=_env_float("IMAGINARY_TPU_CACHE_FRAME_MB", 0.0),
                    help="decoded-frame LRU byte budget in MB (0=off)")
+    p.add_argument("--cache-device-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_CACHE_DEVICE_MB", 0.0),
+                   help="device-resident packed-frame cache byte budget in "
+                        "MB of HBM (0=off); hot sources skip the H2D "
+                        "transfer entirely on repeat requests")
     p.add_argument("--cache-coalesce", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_CACHE_COALESCE"),
                    help="coalesce concurrent identical requests onto one "
@@ -622,8 +633,10 @@ def options_from_args(args) -> ServerOptions:
         hedge_threshold_ms=max(0.0, args.hedge_threshold_ms),
         hedge_budget=min(1.0, max(0.0, args.hedge_budget)),
         prewarm=args.prewarm,
+        transport_dct=args.transport_dct,
         cache_result_mb=max(0.0, args.cache_result_mb),
         cache_frame_mb=max(0.0, args.cache_frame_mb),
+        cache_device_mb=max(0.0, args.cache_device_mb),
         cache_coalesce=args.cache_coalesce,
         cache_source_ttl=max(0.0, args.cache_source_ttl),
         cache_source_mb=max(0.0, args.cache_source_mb),

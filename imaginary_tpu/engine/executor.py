@@ -42,7 +42,7 @@ import numpy as np
 from imaginary_tpu import failpoints
 from imaginary_tpu.engine import host_exec
 from imaginary_tpu.engine.devhealth import DeviceHealthRegistry
-from imaginary_tpu.engine.timing import TIMES
+from imaginary_tpu.engine.timing import TIMES, WIRE
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
@@ -277,6 +277,7 @@ class ExecutorStats:
         # attributable from /health alone (the admission gate and the
         # bench both read this dict)
         snap = TIMES.snapshot()
+        wire = WIRE.snapshot()
         spill_times = snap.get("host_spill")
         form_times = snap.get("batch_form")
         disp_times = snap.get("dispatch_wait")
@@ -329,6 +330,14 @@ class ExecutorStats:
             "host_owed_mpix": round(self.host_owed_mpix, 3),
             "host_spill_p50_ms": spill_times["p50_ms"] if spill_times else 0.0,
             "host_spill_p99_ms": spill_times["p99_ms"] if spill_times else 0.0,
+            # measured link traffic (engine/timing.WIRE: booked where the
+            # batch operand is actually staged / read back, so the device
+            # frame cache's suppressed H2D shows up as bytes NOT counted).
+            # Nested so /metrics renders labeled families
+            # (imaginary_tpu_wire_bytes_total{direction=}).
+            "wire_bytes": {"h2d": wire["h2d"], "d2h": wire["d2h"]},
+            "wire_transfers": {"h2d": wire["h2d_transfers"],
+                               "d2h": wire["d2h_transfers"]},
         }
 
 
@@ -425,7 +434,10 @@ class _Item:
             from imaginary_tpu.ops.buckets import tight_dim
 
             out_bytes = tight_dim(plan.out_h) * tight_dim(plan.out_w) * arr.shape[2]
-        self.wire_mb = (hb * wb * arr.shape[2] + out_bytes) / 1e6
+        # itemsize matters: rgb/yuv inputs are u8, but the dct transport
+        # stages int16 coefficients — 2 wire bytes per element
+        self.wire_mb = (hb * wb * arr.shape[2] * arr.dtype.itemsize
+                        + out_bytes) / 1e6
         self.mpix = in_h * in_w / 1e6
         self.t = time.monotonic()
         # Stamped by the collector when this item's chunk closes; the

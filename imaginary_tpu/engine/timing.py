@@ -105,6 +105,49 @@ class StageTimes:
 # Process-wide registry: the pipeline, executor, and /health all share it.
 TIMES = StageTimes()
 
+
+class WireLedger:
+    """Measured host<->device link bytes, booked where staging actually
+    happens (ops/chain.py: the batch-operand device_put for H2D, the
+    device_get readbacks for D2H).
+
+    This is the ground truth the link projection was missing: the static
+    estimate in bench_device.py recomputed raw-pixel sizes, but what the
+    link really carries depends on transport (rgb vs packed yuv420 vs dct
+    coefficients) and on the device frame cache suppressing repeat H2D.
+    Totals are monotonic counters (exported as
+    imaginary_tpu_wire_bytes_total{direction=}); transfer counts ride along
+    so per-transfer sizes stay derivable. Process-wide like TIMES — the
+    link is a per-host resource, not a per-executor one.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = {"h2d": 0, "d2h": 0}
+        self._transfers = {"h2d": 0, "d2h": 0}
+
+    def add(self, direction: str, nbytes: int) -> None:
+        with self._lock:
+            self._bytes[direction] += int(nbytes)
+            self._transfers[direction] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "h2d": self._bytes["h2d"],
+                "d2h": self._bytes["d2h"],
+                "h2d_transfers": self._transfers["h2d"],
+                "d2h_transfers": self._transfers["d2h"],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._bytes = {"h2d": 0, "d2h": 0}
+            self._transfers = {"h2d": 0, "d2h": 0}
+
+
+WIRE = WireLedger()
+
 _profiler_started = False
 _profiler_lock = threading.Lock()
 

@@ -38,6 +38,29 @@ def bucket_shape(h: int, w: int) -> tuple:
     return bucket_dim(h), bucket_dim(w)
 
 
+def dct_packed_geometry(src_h: int, src_w: int, shrink: int) -> tuple:
+    """Packed coefficient-plane geometry for the dct transport.
+
+    Returns (k, h2, w2, hb, wb): k = 8/shrink kept coefficients per block
+    axis, (h2, w2) = ceil(dim/shrink) valid pixel dims after the scaled
+    IDCT, and (hb, wb) = the Y coefficient-plane bucket. The bucket covers
+    BOTH the shrunk pixel dims and the full MCU-padded block grid
+    (2*ceil(dim/16) blocks of k per axis for 4:2:0) — JPEG entropy-codes
+    whole MCUs, so edge blocks past the valid dims still need packed slots,
+    and keeping the grid an even number of blocks is what lets the chroma
+    coefficient planes split the [hb, hb + hb/2) rows exactly like yuv420.
+    """
+    if shrink not in (1, 2, 4, 8):
+        raise ValueError(f"unsupported dct shrink {shrink}")
+    k = 8 // shrink
+    mcu_y = -(-src_h // 16)
+    mcu_x = -(-src_w // 16)
+    h2 = -(-src_h // shrink)
+    w2 = -(-src_w // shrink)
+    hb, wb = bucket_shape(max(h2, 2 * mcu_y * k), max(w2, 2 * mcu_x * k))
+    return k, h2, w2, hb, wb
+
+
 def tight_dim(n: int) -> int:
     """Snug bucket for *output* dims: device->host readback over the
     interconnect is the scarce resource (~fixed-cost + low bandwidth, see

@@ -31,11 +31,32 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from imaginary_tpu.engine.timing import WIRE
 from imaginary_tpu.ops.buckets import bucket_shape
 from imaginary_tpu.ops.plan import ImagePlan
 
 _CACHE: dict = {}
 _LOCK = threading.Lock()
+
+# Device-resident frame cache (cache.DeviceFrameCache), installed by the
+# web layer when --cache-device-mb > 0. Chain-level rather than
+# executor-level on purpose: run_single, the bench, and every executor
+# launch path stage through launch_batch, so one registry covers them all.
+_DEVICE_FRAMES = None
+
+
+def set_device_frame_cache(cache) -> None:
+    global _DEVICE_FRAMES
+    _DEVICE_FRAMES = cache
+
+
+def device_frame_cache():
+    return _DEVICE_FRAMES
+
+
+def device_frame_cache_bytes() -> int:
+    dc = _DEVICE_FRAMES
+    return dc.bytes_used if dc is not None else 0
 
 # Buffer-donation switch (process-wide, like the link seed): the executor
 # and prewarm must agree on it — the donate flag is part of the compile
@@ -198,6 +219,25 @@ def _stack_dyns(plans: list) -> tuple:
     return tuple(out)
 
 
+def _device_cached_parts(arrs, plans, dc) -> list:
+    """Per-item staged device arrays, served from the device frame cache.
+
+    A hit means the packed input never re-crosses the link; a miss stages
+    that one item (booked to the wire ledger) and caches the resident
+    buffer under the plan's frame_key. The key carries the packed dims, so
+    a cached buffer always matches the batch geometry it joins.
+    """
+    parts = []
+    for a, p in zip(arrs, plans):
+        dev = dc.get(p.frame_key)
+        if dev is None:
+            WIRE.add("h2d", a.nbytes)
+            dev = jax.device_put(a)
+            dc.put(p.frame_key, dev, a.nbytes)
+        parts.append(dev)
+    return parts
+
+
 def launch_batch(arrs: list, plans: list, sharding=None, device=None):
     """Stage + dispatch one batched device call WITHOUT waiting for it.
 
@@ -217,15 +257,23 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
     specs = plans[0].spec_key()
     if not specs:
         return None
+    dev_parts = None
     if plans[0].in_bucket is not None:
         # packed-transport items arrive pre-padded to the bucket (the native
         # decoder writes straight into the packed layout); the image dims
         # are NOT the array dims, they ride on the plan
-        batch = np.stack(arrs)
+        dc = _DEVICE_FRAMES
+        if (dc is not None and dc.enabled and sharding is None
+                and device is None
+                and all(p.frame_key is not None for p in plans)):
+            dev_parts = _device_cached_parts(arrs, plans, dc)
+        batch = None if dev_parts is not None else np.stack(arrs)
+        in_shape = (len(arrs),) + tuple(arrs[0].shape)
         h = np.array([p.in_h for p in plans], dtype=np.int32)
         w = np.array([p.in_w for p in plans], dtype=np.int32)
     else:
         batch = np.stack([pad_to_bucket(a) for a in arrs])
+        in_shape = batch.shape
         h = np.array([a.shape[0] for a in arrs], dtype=np.int32)
         w = np.array([a.shape[1] for a in arrs], dtype=np.int32)
     dyns = _stack_dyns(plans)
@@ -263,7 +311,13 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         # executor's collector — so staging chunk N+1 overlaps compute of
         # chunk N and the fetcher's D2H of chunk N-1. The staged array is a
         # fresh device buffer over the np.stack copy above, which is what
-        # makes donating it aliasing-safe.
+        # makes donating it aliasing-safe. Device-cached parts skip the
+        # link entirely: jnp.stack of resident arrays runs on-device and
+        # its output is a fresh buffer, so donation stays aliasing-safe
+        # and the cached per-item arrays are never consumed.
+        if dev_parts is not None:
+            return jnp.stack(dev_parts)
+        WIRE.add("h2d", batch_host.nbytes)
         if sharding is not None:
             return jax.device_put(batch_host, sharding)
         if device is not None:
@@ -276,7 +330,7 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
     )
     shard_key = _sharding_cache_key(sharding)
     dev_key = _device_cache_key(None if sharding is not None else device)
-    fn = _compiled(specs, batch.shape, dyn_key, shard_key, dev_key,
+    fn = _compiled(specs, in_shape, dyn_key, shard_key, dev_key,
                    donate=donate)
     try:
         y, _, _ = fn(specs, _stage_batch(), jnp.asarray(h), jnp.asarray(w), dyns)
@@ -288,7 +342,7 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         # of the same chain — re-staged from the host copy, since the
         # failed attempt may have consumed the donated buffer.
         _note_donation_rejected()
-        fn = _compiled(specs, batch.shape, dyn_key, shard_key, dev_key,
+        fn = _compiled(specs, in_shape, dyn_key, shard_key, dev_key,
                        donate=False)
         y, _, _ = fn(specs, _stage_batch(), jnp.asarray(h), jnp.asarray(w), dyns)
     return y
@@ -315,6 +369,7 @@ def fetch_groups(ys: list) -> list:
     """
     live = [y for y in ys if y is not None]
     if live:
+        WIRE.add("d2h", sum(int(y.nbytes) for y in live))
         fetched = iter(jax.device_get(live))
         return [np.asarray(next(fetched)) if y is not None else None for y in ys]
     return [None] * len(ys)
@@ -332,7 +387,9 @@ def finish_batch(host_y, arrs: list, plans: list) -> list:
     """
     if host_y is None:
         return [np.asarray(a) for a in arrs]
-    if plans[0].transport == "yuv420":
+    if plans[0].transport in ("yuv420", "dct"):
+        # dct chains end in the same ToYuv420Spec repack, so both packed
+        # transports slice planes out of the identical layout
         from imaginary_tpu.codecs import unpack_planes
 
         return [
@@ -346,6 +403,7 @@ def fetch_batch(y, arrs: list, plans: list) -> list:
     """Block on a launch_batch result and slice out per-image outputs."""
     if y is None:
         return [np.asarray(a) for a in arrs]
+    WIRE.add("d2h", int(y.nbytes))
     return finish_batch(np.asarray(jax.device_get(y)), arrs, plans)
 
 

@@ -22,7 +22,13 @@ import numpy as np
 from imaginary_tpu.errors import ImageError, new_error
 from imaginary_tpu.imgtype import ImageType, image_type
 from imaginary_tpu.options import Colorspace, Extend, Gravity, ImageOptions, apply_aspect_ratio
-from imaginary_tpu.ops.buckets import MAX_DIM, bucket_dim, bucket_shape, tight_dim
+from imaginary_tpu.ops.buckets import (
+    MAX_DIM,
+    bucket_dim,
+    bucket_shape,
+    dct_packed_geometry,
+    tight_dim,
+)
 from imaginary_tpu.ops.stages import (
     BlurSpec,
     CompositeSpec,
@@ -56,11 +62,18 @@ class StageInstance:
 class ImagePlan:
     """Device work for one request: the chain key is (specs, in-bucket, C).
 
-    transport: "rgb" (HWC arrays both ways) or "yuv420" (packed subsampled
-    planes both ways — half the link bytes; JPEG-in/JPEG-out requests only).
-    For yuv420 plans the item array is the pre-padded packed buffer, so the
-    packed dims (in_bucket), the true image dims (in_h/in_w), and the output
-    Y bucket (out_bucket, for host-side plane slicing) ride on the plan.
+    transport: "rgb" (HWC arrays both ways), "yuv420" (packed subsampled
+    planes both ways — half the link bytes; JPEG-in/JPEG-out requests only),
+    or "dct" (packed quantized DCT coefficients in, packed yuv420 out — the
+    host ships entropy-decoded coefficients and the device runs the IDCT).
+    For packed-transport plans the item array is the pre-padded packed
+    buffer, so the packed dims (in_bucket), the true image dims (in_h/in_w),
+    and the output Y bucket (out_bucket, for host-side plane slicing) ride
+    on the plan.
+
+    frame_key: identity of the staged input for the device-resident frame
+    cache ((content digest, shrink, transport, packed dims) — see
+    cache.DeviceFrameCache). None means "don't device-cache this input".
     """
 
     stages: list
@@ -71,6 +84,7 @@ class ImagePlan:
     in_h: int = 0
     in_w: int = 0
     out_bucket: Optional[tuple] = None  # output Y bucket dims (hb, wb)
+    frame_key: Optional[tuple] = None
 
     def spec_key(self) -> tuple:
         return tuple(s.spec for s in self.stages)
@@ -106,6 +120,50 @@ def wrap_plan_yuv420(plan: ImagePlan, src_h: int, src_w: int) -> ImagePlan:
         in_h=src_h,
         in_w=src_w,
         out_bucket=(out_hb, out_wb),
+    )
+
+
+def wrap_plan_dct(plan: ImagePlan, src_h: int, src_w: int, shrink: int,
+                  frame_key: Optional[tuple] = None) -> ImagePlan:
+    """Re-express an RGB plan (planned at the SHRUNK dims) as a
+    dct-transport plan.
+
+    Prepends the device-side scaled IDCT + chroma upsample (FromDctSpec
+    consumes codecs/jpeg_dct.py's packed coefficient buffer)
+    and appends the yuv420 repack for the readback; the wrapped chain is
+    the SAME RGB geometry in the middle, so every operation composes
+    unchanged. `plan` must have been planned at (ceil(src/shrink)) dims —
+    the dims the scaled IDCT reconstructs. Identity plans return unchanged:
+    with no pixels host-side there is nothing to short-circuit to, so the
+    caller must route those to the rgb/yuv paths instead.
+
+    The coefficient bucket can exceed bucket_shape(shrunk dims) when the
+    MCU-padded block grid crosses a ladder rung; a static ShrinkBucketSpec
+    restores the exact mid-chain geometry the RGB plan was built against.
+    """
+    from imaginary_tpu.ops.stages import FromDctSpec, ToYuv420Spec
+
+    if not plan.stages:
+        return plan
+    k, h2, w2, hb, wb = dct_packed_geometry(src_h, src_w, shrink)
+    stages = [StageInstance(FromDctSpec(hb, wb, k), {})]
+    bh2, bw2 = bucket_shape(h2, w2)
+    if (hb, wb) != (bh2, bw2):
+        stages.append(StageInstance(ShrinkBucketSpec(bh2, bw2), {}))
+    out_hb, out_wb = _final_bucket(plan.stages, h2, w2)
+    stages = stages + plan.stages + [StageInstance(ToYuv420Spec(out_hb, out_wb), {})]
+    return ImagePlan(
+        stages=stages,
+        out_h=plan.out_h,
+        out_w=plan.out_w,
+        transport="dct",
+        # full scale packs yuv420-style [hb + hb/2, wb, 1]; shrunk scales
+        # pack [hb, wb, 3] (chroma folded at 2k — see codecs/jpeg_dct.py)
+        in_bucket=(hb + hb // 2, wb) if shrink == 1 else (hb, wb),
+        in_h=h2,
+        in_w=w2,
+        out_bucket=(out_hb, out_wb),
+        frame_key=frame_key,
     )
 
 

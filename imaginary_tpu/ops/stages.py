@@ -360,6 +360,32 @@ def _chroma_up_indices(out_n: int, cn, chroma_b: int):
     return i0, jnp.minimum(i1, chroma_b - 1), t
 
 
+def _ycc_to_rgb(y, uu, vv):
+    """BT.601 full-range YCbCr -> RGB on already level-shifted chroma."""
+    r = y + 1.402 * vv
+    g = y - 0.344136 * uu - 0.714136 * vv
+    b = y + 1.772 * uu
+    return jnp.clip(jnp.stack([r, g, b], axis=-1), 0.0, 255.0)
+
+
+def _yuv420_to_rgb(y, u, v, h, w, hb: int, wb: int):
+    """Shared tail of the yuv420/dct transports: centered 2x chroma
+    upsample (libjpeg fancy-upsampling weights, rows then cols as
+    per-batch clamped gathers) + BT.601 full-range YCbCr -> RGB."""
+    ch = (h + 1) // 2
+    cw = (w + 1) // 2
+
+    def up2(plane):
+        i0, i1, t = _chroma_up_indices(hb, ch, hb // 2)
+        rows = jax.vmap(lambda p, a, b: (p[a], p[b]))(plane, i0, i1)
+        plane = rows[0] * (1.0 - t)[None, :, None] + rows[1] * t[None, :, None]
+        j0, j1, s = _chroma_up_indices(wb, cw, wb // 2)
+        cols = jax.vmap(lambda p, a, b: (p[:, a], p[:, b]))(plane, j0, j1)
+        return cols[0] * (1.0 - s)[None, None, :] + cols[1] * s[None, None, :]
+
+    return _ycc_to_rgb(y, up2(u) - 128.0, up2(v) - 128.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class FromYuv420Spec:
     """Unpack the packed YUV420 transport buffer into RGB.
@@ -367,9 +393,9 @@ class FromYuv420Spec:
     Input x is [B, hb + hb/2, wb, 1]: Y plane in rows [0, hb); the chroma
     block below holds U in columns [0, wb/2) and V in [wb/2, wb), each
     ceil(h/2) x ceil(w/2) valid. Chroma upsamples 2x with the centered
-    triangle filter (libjpeg fancy-upsampling weights), then BT.601
-    full-range YCbCr -> RGB — the color math the host skipped runs here,
-    on the device, against half the transfer bytes.
+    triangle filter, then BT.601 full-range YCbCr -> RGB — the color math
+    the host skipped runs here, on the device, against half the transfer
+    bytes.
     """
 
     hb: int
@@ -380,25 +406,73 @@ class FromYuv420Spec:
         y = x[:, :hb, :, 0]
         u = x[:, hb:, : wb // 2, 0]
         v = x[:, hb:, wb // 2 :, 0]
-        ch = (h + 1) // 2
-        cw = (w + 1) // 2
+        return _yuv420_to_rgb(y, u, v, h, w, hb, wb), h, w
 
-        def up2(plane):
-            # rows then cols, per-batch clamped gathers
-            i0, i1, t = _chroma_up_indices(hb, ch, hb // 2)
-            rows = jax.vmap(lambda p, a, b: (p[a], p[b]))(plane, i0, i1)
-            plane = rows[0] * (1.0 - t)[None, :, None] + rows[1] * t[None, :, None]
-            j0, j1, s = _chroma_up_indices(wb, cw, wb // 2)
-            cols = jax.vmap(lambda p, a, b: (p[:, a], p[:, b]))(plane, j0, j1)
-            return cols[0] * (1.0 - s)[None, None, :] + cols[1] * s[None, None, :]
 
-        uu = up2(u) - 128.0
-        vv = up2(v) - 128.0
-        r = y + 1.402 * vv
-        g = y - 0.344136 * uu - 0.714136 * vv
-        b = y + 1.772 * uu
-        rgb = jnp.clip(jnp.stack([r, g, b], axis=-1), 0.0, 255.0)
-        return rgb, h, w
+def _idct_basis(k: int):
+    """Scaled k-point inverse-DCT basis: orthonormal C[u, x] = beta_u *
+    cos((2x+1) u pi / 2k) times the sqrt(k/8)-per-axis energy factor of
+    JPEG's reduced-size decode. For k == 8 the factor is 1 and this IS the
+    JPEG IDCT basis (beta_0 = sqrt(1/8) = C(0)/2, beta_u = sqrt(2/8) =
+    1/2); for k < 8 the host ships frequency-folded coefficients
+    (codecs/jpeg_dct.py) and this basis reconstructs libjpeg's scaled
+    decode exactly."""
+    u = jnp.arange(k, dtype=jnp.float32)[:, None]
+    x = jnp.arange(k, dtype=jnp.float32)[None, :]
+    beta = jnp.where(u == 0, jnp.sqrt(1.0 / k), jnp.sqrt(2.0 / k))
+    basis = beta * jnp.cos((2.0 * x + 1.0) * u * jnp.pi / (2.0 * k))
+    return basis * jnp.sqrt(k / 8.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class FromDctSpec:
+    """Scaled-IDCT the packed DCT-coefficient buffer into RGB.
+
+    Input is *dequantized, frequency-folded coefficients* (int16 on the
+    wire, f32 by the time stages run) in the jpeg_dct packed layout. Two
+    static layouts, mirroring libjpeg's per-component scaled decode:
+
+    - k == 8 (full scale): x is [B, hb + hb/2, wb, 1], yuv420-style — Y
+      blocks in rows [0, hb), half-resolution chroma blocks below; the
+      8-point IDCT is followed by the shared fancy chroma upsample.
+    - k < 8 (shrink-on-load): x is [B, hb, wb, 3]. Y was folded to k x k
+      but chroma — stored at half resolution — folds only to 2k x 2k, so
+      after the per-channel IDCT all three planes land at the SAME output
+      resolution and no upsample runs at all. That is exactly what
+      libjpeg does (chroma DCT_scaled_size = 2x luma's), which is what
+      makes parity with the host decoder exact instead of filter-shaped.
+
+    One fused program from coefficients to RGB, with the host having done
+    only the serial entropy decode and an exact integer dequantize/fold.
+    No dyn inputs: the compile cache sees only static (bucket, k) shapes.
+    """
+
+    hb: int
+    wb: int
+    k: int
+
+    def apply(self, x, h, w, dyn):
+        hb, wb, k = self.hb, self.wb, self.k
+
+        def idct(plane, kk, ph, pw):
+            basis = _idct_basis(kk)
+            blk = plane.reshape(-1, ph // kk, kk, pw // kk, kk)
+            # f32 on purpose (vs _mm_dtype): dequantized coefficients reach
+            # +-4k where bf16 resolves only +-16 — visible banding; the
+            # contractions are k <= 8 wide, so MXU rate is not the limiter
+            out = jnp.einsum("brucv,ux,vz->brxcz", blk, basis, basis,
+                             preferred_element_type=jnp.float32)
+            return out.reshape(-1, ph, pw) + 128.0
+
+        if k == 8:
+            y = idct(x[:, :hb, :, 0], 8, hb, wb)
+            u = idct(x[:, hb:, : wb // 2, 0], 8, hb // 2, wb // 2)
+            v = idct(x[:, hb:, wb // 2 :, 0], 8, hb // 2, wb // 2)
+            return _yuv420_to_rgb(y, u, v, h, w, hb, wb), h, w
+        y = idct(x[..., 0], k, hb, wb)
+        uu = idct(x[..., 1], 2 * k, hb, wb) - 128.0
+        vv = idct(x[..., 2], 2 * k, hb, wb) - 128.0
+        return _ycc_to_rgb(y, uu, vv), h, w
 
 
 @dataclasses.dataclass(frozen=True)

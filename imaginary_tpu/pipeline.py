@@ -38,6 +38,7 @@ from imaginary_tpu.ops.plan import (
     ImagePlan,
     choose_decode_shrink,
     plan_operation,
+    wrap_plan_dct,
     wrap_plan_yuv420,
 )
 
@@ -50,6 +51,22 @@ MAX_PIPELINE_OPERATIONS = 10  # ref: image.go:383-385
 # "jpg" alias; "" and "auto" inherit a JPEG source) — the packed-YUV420
 # transport gate.
 _JPEG_TYPE_NAMES = ("", "jpeg", "jpg", "auto")
+
+# Compressed-domain ingest (--transport-dct): host entropy decode ships
+# dequantized DCT coefficients to the device, which runs the IDCT + color
+# convert itself (codecs/jpeg_dct.py + ops FromDctSpec). OFF by default —
+# every new transport is opt-in so off-state responses stay byte-identical.
+_TRANSPORT_DCT = False
+
+
+def set_transport_dct(on: bool) -> None:
+    """Flip the dct transport on/off (wired from --transport-dct)."""
+    global _TRANSPORT_DCT
+    _TRANSPORT_DCT = bool(on)
+
+
+def transport_dct_enabled() -> bool:
+    return _TRANSPORT_DCT
 
 # Injected by the web layer: url -> RGBA ndarray (watermarkimage fetch,
 # image.go:343-370). Kept injectable so the ops layer stays network-free.
@@ -220,6 +237,14 @@ def process_operation(
     t_probe = time.monotonic()
     TIMES.record("probe", (t_probe - t_start) * 1000.0)
 
+    if _dct_eligible(src_type, meta, o):
+        out = _process_dct(name, buf, o, meta, shrink,
+                           watermark_fetcher, runner, t_start,
+                           frame_cache, source_digest)
+        if out is not None:
+            TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
+            return out
+
     if _yuv_eligible(src_type, meta, o):
         out = _process_yuv420(name, buf, o, meta, shrink,
                               watermark_fetcher, runner, t_start,
@@ -240,6 +265,21 @@ def process_operation(
                           plan.out_w, plan.out_h)
     TIMES.record("total", (time.monotonic() - t_start) * 1000.0)
     return out
+
+
+def _dct_eligible(src_type, meta, o: ImageOptions) -> bool:
+    """Gate for the compressed-domain transport: 4:2:0 JPEG in, JPEG out,
+    and the switch on. Coarser than the entropy decoder's own scope check
+    (baseline, 8-bit, no odd sampling factors) — decode_packed re-verifies
+    and returns None on anything it can't prove, falling back to yuv/rgb.
+    No native codec needed: the entropy decode is pure Python/numpy."""
+    if not _TRANSPORT_DCT:
+        return False
+    if src_type is not ImageType.JPEG or meta is None:
+        return False
+    if meta.subsampling != "420":
+        return False
+    return o.type in _JPEG_TYPE_NAMES
 
 
 def _yuv_eligible(src_type, meta, o: ImageOptions) -> bool:
@@ -306,6 +346,69 @@ def _decode_yuv_packed(buf, shrink, sh, sw, frame_cache=None, digest=None):
         packed.setflags(write=False)
         frame_cache.put(key, (packed, hb, wb), packed.nbytes)
     return packed, hb, wb
+
+
+def _decode_dct_packed(buf, shrink, frame_cache=None, digest=None):
+    """Entropy-decode + dequantize + fold + pack coefficients for device
+    IDCT; None means 'use the yuv/rgb paths' (out-of-scope stream). The
+    packed coefficient buffer caches under its own kind tag, and the same
+    digest-scoped key doubles as the DEVICE frame-cache key (ops/chain.py
+    pins the staged device buffer under it, so a hot source pays zero H2D
+    on repeat requests). Returns (packed, h2, w2, frame_key) or None."""
+    key = None
+    if frame_cache is not None and digest is not None:
+        key = (digest, shrink, "dct")
+        hit = frame_cache.get(key)
+        if hit is not None:
+            packed, h2, w2 = hit
+            return packed, h2, w2, key
+    t0 = time.monotonic()
+    failpoints.hit("codec.decode")
+    from imaginary_tpu.codecs import jpeg_dct
+
+    got = jpeg_dct.decode_packed(buf, shrink)
+    if got is None:
+        return None
+    packed, h2, w2 = got
+    TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    fkey = (digest, shrink, "dct") if digest is not None else None
+    if key is not None:
+        packed.setflags(write=False)
+        frame_cache.put(key, (packed, h2, w2), packed.nbytes)
+    return packed, h2, w2, fkey
+
+
+def _process_dct(name, buf, o, meta, shrink, watermark_fetcher, runner,
+                 t_start, frame_cache=None,
+                 source_digest=None) -> Optional[ProcessedImage]:
+    """Serve a JPEG->JPEG request over the compressed-domain transport.
+
+    Returns None to fall back (yuv420 then rgb): out-of-scope stream,
+    probe/SOF0 dims disagreement, or an identity chain — the packed
+    transports short-circuit identity better (raw planes straight to the
+    encoder), and dct coefficients have no encoder-facing unpacked form.
+    Parameter-validation errors still raise, exactly as the other paths
+    would, since the plan math is identical.
+    """
+    sh = -(-meta.height // shrink)
+    sw = -(-meta.width // shrink)
+    got = _decode_dct_packed(buf, shrink, frame_cache, source_digest)
+    if got is None:
+        return None
+    packed, h2, w2, fkey = got
+    if (h2, w2) != (sh, sw):
+        return None
+    wm = _fetch_watermark(name, o, watermark_fetcher)
+    plan = plan_operation(name, o, sh, sw, meta.orientation, 3,
+                          watermark_rgba=wm)
+    if not plan.stages:
+        return None
+    wrapped = wrap_plan_dct(plan, meta.height, meta.width, shrink,
+                            frame_key=fkey)
+    result = _run_stages(packed, wrapped, runner)
+    out = _encode(result, o, _encode_type(o, ImageType.JPEG))
+    return _carry_metadata(buf, o.strip_metadata, out, not o.no_rotation,
+                           plan.out_w, plan.out_h)
 
 
 def _process_yuv420(name, buf, o, meta, shrink, watermark_fetcher, runner,
@@ -414,6 +517,25 @@ def process_pipeline(
         (op.params or {}).get("type") in (None,) + _JPEG_TYPE_NAMES
         for op in o.operations
     )
+    if ops_keep_jpeg and _dct_eligible(src_type, meta, o):
+        sh = -(-meta.height // shrink)
+        sw = -(-meta.width // shrink)
+        got = _decode_dct_packed(buf, shrink, frame_cache, source_digest)
+        if got is not None and (got[1], got[2]) == (sh, sw):
+            packed, _h2, _w2, fkey = got
+            combined, final_o, target, rotated, strip = _build_pipeline_plan(
+                o, sh, sw, meta.orientation, 3, ImageType.JPEG, watermark_fetcher
+            )
+            # identity chains fall through: the yuv path below serves them
+            # straight from raw planes with no device round-trip at all
+            if combined.stages:
+                wrapped = wrap_plan_dct(combined, meta.height, meta.width,
+                                        shrink, frame_key=fkey)
+                result = _run_stages(packed, wrapped, runner)
+                out = _encode(result, final_o, target)
+                return _carry_metadata(buf, strip, out, rotated,
+                                       combined.out_w, combined.out_h)
+
     if ops_keep_jpeg and _yuv_eligible(src_type, meta, o):
         sh = -(-meta.height // shrink)
         sw = -(-meta.width // shrink)

@@ -151,14 +151,25 @@ def warm_chain(op: str, opts: ImageOptions, h: int, w: int,
         shrink = choose_decode_shrink(op, opts, h, w, 0, 3)
     except Exception:
         shrink = 1
-    dims = {(h, w), ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink)}
+    # map decode dims -> the shrink that produced them: the dct transport
+    # compiles a DIFFERENT program per (bucket, shrink) because the fold
+    # factor k = 8//shrink is baked into the FromDctSpec shapes
+    dim_shrink = {(h, w): 1}
+    dim_shrink.setdefault(
+        ((h + shrink - 1) // shrink, (w + shrink - 1) // shrink), shrink)
     try:
         from imaginary_tpu import codecs as _codecs
 
         warm_yuv = _codecs.yuv420_supported()
     except Exception:
         warm_yuv = False
-    for dh, dw in dims:
+    try:
+        from imaginary_tpu import pipeline as pipeline_mod
+
+        warm_dct = pipeline_mod.transport_dct_enabled()
+    except Exception:
+        warm_dct = False
+    for (dh, dw), dshrink in dim_shrink.items():
         try:
             plan = plan_operation(op, opts, dh, dw, 0, 3)
         except Exception:
@@ -170,6 +181,12 @@ def warm_chain(op: str, opts: ImageOptions, h: int, w: int,
             from imaginary_tpu.ops.plan import wrap_plan_yuv420
 
             plans.append((wrap_plan_yuv420(plan, dh, dw), "yuv"))
+        if warm_dct and plan.stages and dshrink in (1, 2, 4, 8):
+            # compressed-domain transport: the device runs IDCT + color
+            # convert on packed int16 coefficients (ops FromDctSpec)
+            from imaginary_tpu.ops.plan import wrap_plan_dct
+
+            plans.append((wrap_plan_dct(plan, h, w, dshrink), "dct"))
         for pl, kind in plans:
             for b in batch_sizes:
                 key = (pl.spec_key(), chain_mod.bucket_shape(dh, dw), b)
@@ -191,6 +208,12 @@ def _dummy_input(pl, kind, dh, dw) -> np.ndarray:
     if kind == "yuv":
         ph, wb = pl.in_bucket
         return np.zeros((ph, wb, 1), dtype=np.uint8)
+    if kind == "dct":
+        # full-scale packs Y+U+V into one int16 plane (yuv420-style rows);
+        # shrunk scales channel-pack Y/U/V folded coefficients
+        ph, wb = pl.in_bucket
+        ch = 1 if pl.stages[0].spec.k == 8 else 3
+        return np.zeros((ph, wb, ch), dtype=np.int16)
     return np.zeros((dh, dw, 3), dtype=np.uint8)
 
 

@@ -195,6 +195,11 @@ class ServerOptions:
     hedge_threshold_ms: float = 0.0
     hedge_budget: float = 0.05
     prewarm: bool = False
+    # compressed-domain ingest (codecs/jpeg_dct.py): host entropy decode
+    # ships dequantized DCT coefficients; the device runs IDCT + color
+    # convert, with shrink-on-load folded in the DCT domain. OFF by
+    # default (parity: responses stay byte-identical when off).
+    transport_dct: bool = False
     # --- content-addressed caching (imaginary_tpu/cache.py) ------------------
     # All tiers default OFF: with every knob at 0/False the serving path is
     # byte-identical to the uncached build (PARITY.md "Cache semantics").
@@ -204,6 +209,12 @@ class ServerOptions:
     # decoded-frame LRU byte budget in MB (digest -> ndarray; different ops
     # on the same hot source skip decode)
     cache_frame_mb: float = 0.0
+    # device-resident packed-frame cache byte budget in MB (HBM): staged
+    # transport inputs pin on-device keyed by (digest, shrink, transport),
+    # so a hot source pays ZERO H2D wire bytes on repeat requests. Shrinks
+    # to half under elevated memory pressure, disables under critical
+    # (cache.py apply_pressure).
+    cache_device_mb: float = 0.0
     # singleflight: N concurrent identical (digest, plan) requests run the
     # pipeline once and fan the result out
     cache_coalesce: bool = False

@@ -127,6 +127,21 @@ class ImageService:
         self.frame_cache = cache_mod.FrameCache(self.caches.frames,
                                                 self.caches.stats)
         self.registry = SourceRegistry(o, caches=self.caches)
+        # compressed-domain transport switch + device-resident frame
+        # cache: both ride module-level registries (pipeline and chain
+        # respectively), matching how donation is wired — the settings
+        # must be in place before the first dispatch compiles anything
+        from imaginary_tpu import pipeline as pipeline_mod
+
+        pipeline_mod.set_transport_dct(o.transport_dct)
+        from imaginary_tpu.ops import chain as dev_chain_mod
+
+        if o.cache_device_mb > 0:
+            dev_chain_mod.set_device_frame_cache(
+                cache_mod.DeviceFrameCache(self.caches.device,
+                                           self.caches.stats))
+        else:
+            dev_chain_mod.set_device_frame_cache(None)
         if pressure is not None:
             # cache tiers shrink/restore their budgets on the governor's
             # transition edge (elevated halves, critical quarters +

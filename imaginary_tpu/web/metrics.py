@@ -37,7 +37,7 @@ _EXEC_GAUGES = {
 }
 _CACHE_GAUGES = {
     "result_items", "result_bytes", "frame_items", "frame_bytes",
-    "source_items", "source_bytes",
+    "source_items", "source_bytes", "device_items", "device_bytes",
 }
 
 
@@ -85,6 +85,7 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
     stage_total: list = []
     qos_classes: dict = {}
     hedge_outcomes: dict = {}
+    wire: dict = {}
     device_health: dict = {}
     pressure: dict = {}
     integrity: dict = {}
@@ -105,6 +106,11 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                     # (imaginary_tpu_hedges_total{outcome=}) instead of
                     # five scalar ones
                     hedge_outcomes = v
+                    continue
+                if k in ("wire_bytes", "wire_transfers") and isinstance(v, dict):
+                    # deferred: direction-labeled families (one family
+                    # per unit, h2d/d2h as labels)
+                    wire[k] = v
                     continue
                 mtype = "gauge" if k in _EXEC_GAUGES else "counter"
                 x.emit(f"imaginary_tpu_executor_{_snake(k)}", v, mtype=mtype,
@@ -186,6 +192,18 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                f'outcome="{escape_label_value(outcome)}"', mtype="counter",
                help_text="Hedged failover dispatches by outcome "
                          "(won|lost|failed|skipped_budget).")
+    for direction, v in sorted(wire.get("wire_bytes", {}).items()):
+        x.emit("imaginary_tpu_wire_bytes_total", v,
+               f'direction="{escape_label_value(direction)}"',
+               mtype="counter",
+               help_text="Bytes actually staged across the device link "
+                         "(h2d = host-to-device batch stages, d2h = "
+                         "result drains).")
+    for direction, v in sorted(wire.get("wire_transfers", {}).items()):
+        x.emit("imaginary_tpu_wire_transfers_total", v,
+               f'direction="{escape_label_value(direction)}"',
+               mtype="counter",
+               help_text="Device-link transfer operations by direction.")
     if device_health:
         x.emit("imaginary_tpu_devices_healthy", device_health.get("healthy", 0),
                help_text="Dispatchable devices in the healthy state.")
