@@ -127,6 +127,9 @@ bench-qos:
 bench-device:
 	BENCH_AB=1 BENCH_PLATFORM=cpu python bench_device.py
 	BENCH_TRANSPORT_AB=1 BENCH_PLATFORM=cpu python bench_device.py
+	BENCH_MESH_AB=1 BENCH_PLATFORM=cpu \
+	  XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  python bench_device.py
 
 # bomb + oversize-enlarge firehose, governor on vs off: the governed arm
 # must hold >=95% well-formed availability (only 200/413/503/504) with

@@ -79,10 +79,17 @@ roll. Invariants: 100% ultimate availability (the retry contract may
 be used, zero requests lost), per-index epochs strictly monotonic, and
 both indices finish on fresh epochs.
 
+ROW 10 — lanes chip loss (ISSUE 15): a 4-device child process (this
+one is pinned at 2) runs a `--mesh-policy lanes` executor, kills chip 0
+mid-run with `device.chip_error[0]=error`, and holds 100% availability
+while exactly one lane quarantines, the mesh generation bumps exactly
+once per topology epoch, and the probe re-admits the chip afterwards.
+
 Prints one JSON line per row on stdout; human detail on stderr; nonzero
 exit on any violated invariant. Integrity/fail-slow counters from rows
 5-6 are archived to artifacts/chaos_integrity.json; fleet counters from
-rows 7-9 to artifacts/chaos_fleet.json.
+rows 7-9 to artifacts/chaos_fleet.json; the lane drill's row to
+artifacts/chaos_lanes.json.
 """
 
 from __future__ import annotations
@@ -1353,6 +1360,170 @@ def _fleet_roll_row(duration: float, concurrency: int) -> tuple:
     return 0, row
 
 
+# --- row 10 (ISSUE 15): per-chip lanes under chip loss -----------------------
+
+
+def _lanes_chip_loss_child() -> int:
+    """ROW 10 body — runs in a SUBPROCESS with 4 virtual devices (the
+    parent fixed XLA's host device count at 2 at first jax import, so a
+    4-lane drill cannot run in-process). Direct executor drive, no HTTP:
+    a 4-lane executor takes traffic, `device.chip_error[0]=error` kills
+    chip 0 mid-run, and the invariants are the lane tier's whole story:
+
+      * availability is 100% — every future completes; the drained
+        lane's items re-place onto survivors, nothing errors out;
+      * exactly ONE lane quarantines, and the mesh generation bumps
+        exactly ONCE for the epoch (the compile-key pin: chip loss is
+        one recompile, never a per-request compile storm);
+      * after the fault clears, the half-open probe re-admits chip 0 —
+        the lane is active again and the generation bumps once more.
+    """
+    import numpy as np
+
+    from imaginary_tpu import failpoints
+    from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops.plan import plan_operation
+
+    # host_spill off: the drill must exercise the LANES under chip loss;
+    # the auto cost model would route the fault away to the host SIMD
+    # path and the row would test nothing
+    ex = Executor(ExecutorConfig(mesh_policy="lanes", n_devices=4,
+                                 host_spill=False, window_ms=1.0,
+                                 breaker_threshold=1,
+                                 breaker_cooldown_s=1.0))
+    ok = total = 0
+    try:
+        rng = np.random.default_rng(3)
+        arr = rng.integers(0, 256, (96, 96, 3), dtype=np.uint8)
+        opts = ImageOptions(width=48)
+        plan = plan_operation("resize", opts, 96, 96, 0, 3)
+        # prewarm the per-lane compile keys: a cold first dispatch books
+        # its compile time into that lane's EWMA and the scheduler
+        # starves it — the fault on chip 0 would never be exercised
+        from imaginary_tpu.prewarm import warm_chain, warm_mesh_paths
+
+        warm_chain("resize", opts, 96, 96, (1, 2, 4, 8, 16))
+        warm_mesh_paths(ex, "resize", opts, 96, 96,
+                        batch_sizes=(1, 2, 4, 8, 16))
+        for _ in range(8):  # warm every lane's EWMA before the fault
+            ex.submit(arr, plan).result(timeout=60)
+        gen0 = ex._mesh_generation
+        failpoints.activate("device.chip_error[0]=error")
+        futs = [ex.submit(arr, plan) for _ in range(48)]
+        for f in futs:
+            total += 1
+            try:
+                f.result(timeout=60)
+                ok += 1
+            except Exception:
+                pass
+        lane0 = ex._lanes.lane(0)
+        deadline = time.monotonic() + 10.0
+        while lane0.active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        quarantined_mid = sum(1 for ln in ex._lanes.lanes if not ln.active)
+        gen_mid = ex._mesh_generation
+        failpoints.deactivate()
+        # probe-driven re-admission (cooldown 1 s); light traffic keeps
+        # the collectors polling topology
+        deadline = time.monotonic() + 30.0
+        while not lane0.active and time.monotonic() < deadline:
+            total += 1
+            try:
+                ex.submit(arr, plan).result(timeout=60)
+                ok += 1
+            except Exception:
+                pass
+            time.sleep(0.05)
+        readmitted = lane0.active
+        gen_end = ex._mesh_generation
+    finally:
+        failpoints.deactivate()
+        ex.shutdown()
+
+    row = {
+        "metric": "lanes_chip_loss",
+        "devices": 4,
+        "requests": total,
+        "ok": ok,
+        "availability": round(ok / total, 4) if total else 0.0,
+        "quarantined_mid_fault": quarantined_mid,
+        "gen_bumps_mid_fault": gen_mid - gen0,
+        "readmitted": readmitted,
+        "gen_bumps_total": gen_end - gen0,
+    }
+    print(json.dumps(row), flush=True)
+    fails = []
+    if total == 0 or ok != total:
+        fails.append(f"availability {ok}/{total} under chip loss "
+                     "(lane drain must re-place, not fail)")
+    if quarantined_mid != 1:
+        fails.append(f"{quarantined_mid} lanes quarantined mid-fault "
+                     "(want exactly the sick chip's)")
+    if gen_mid - gen0 != 1:
+        fails.append(f"mesh generation bumped {gen_mid - gen0}x mid-fault "
+                     "(want exactly 1 per topology epoch)")
+    if not readmitted:
+        fails.append("chip 0's lane never re-admitted after the fault "
+                     "cleared")
+    elif gen_end - gen0 != 2:
+        fails.append(f"generation bumped {gen_end - gen0}x total "
+                     "(want 2: out + back in)")
+    for f in fails:
+        print(f"[chaos] FAIL (lanes child): {f}", file=sys.stderr)
+    if not fails:
+        print(f"[chaos] lanes child: {ok}/{total} ok, one quarantine, "
+              f"gen +{gen_end - gen0}, re-admitted", file=sys.stderr)
+    return 1 if fails else 0
+
+
+def _lanes_chip_loss_row() -> tuple:
+    """ROW 10 parent half: re-exec this file with `--lanes-row` under
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 (the device count
+    is burned in at first jax import, so the 4-lane drill needs its own
+    process) and relay the child's JSON row + verdict."""
+    print("[chaos] row 10: 4-lane chip-loss drill in a fresh 4-device "
+          "child process", file=sys.stderr)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("IMAGINARY_TPU_FAILPOINTS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--lanes-row"],
+            env=env, capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        row = {"metric": "lanes_chip_loss", "error": "child timed out"}
+        print(json.dumps(row))
+        print("[chaos] FAIL: lanes chip-loss child timed out",
+              file=sys.stderr)
+        return 1, row
+    sys.stderr.write(proc.stderr)
+    row = None
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            cand = json.loads(ln)
+        except ValueError:
+            continue
+        if cand.get("metric") == "lanes_chip_loss":
+            row = cand
+    if row is not None:
+        print(json.dumps(row))
+    if proc.returncode or row is None:
+        print(f"[chaos] FAIL: lanes chip-loss child rc={proc.returncode}",
+              file=sys.stderr)
+        return 1, (row or {"metric": "lanes_chip_loss",
+                           "error": f"child rc {proc.returncode}"})
+    print("[chaos] PASS (lanes chip loss): 100% available, one "
+          "quarantine, one generation bump per epoch, re-admitted",
+          file=sys.stderr)
+    return 0, row
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -1457,8 +1628,24 @@ def main() -> int:
     except OSError as e:
         print(f"[chaos] WARN: could not archive fleet counters: {e}",
               file=sys.stderr)
-    return rc_roll
+    if rc_roll:
+        return rc_roll
+    # ROW 10 (ISSUE 15): per-chip lanes lose chip 0 mid-run — runs in a
+    # 4-device child process (this one is pinned at 2)
+    rc_lanes, lanes_row = _lanes_chip_loss_row()
+    try:
+        with open("artifacts/chaos_lanes.json", "w") as f:
+            json.dump({"lanes_chip_loss": lanes_row}, f, indent=2,
+                      sort_keys=True)
+        print("[chaos] lane counters archived to "
+              "artifacts/chaos_lanes.json", file=sys.stderr)
+    except OSError as e:
+        print(f"[chaos] WARN: could not archive lane counters: {e}",
+              file=sys.stderr)
+    return rc_lanes
 
 
 if __name__ == "__main__":
+    if "--lanes-row" in sys.argv:
+        sys.exit(_lanes_chip_loss_child())
     sys.exit(main())

@@ -289,6 +289,159 @@ def policy_ab() -> int:
 # or delete on a loss"). The einsum path in ops/stages.py carries the note.
 
 
+def mesh_ab():
+    """Multi-chip lanes vs single-queue A/B (ISSUE 15 acceptance row):
+    `--mesh-policy lanes` at 4 devices against the single device queue
+    (policy off), same workload, under a measured-link D2H simulation.
+
+    The pacing wraps fetch_groups with a fixed per-drain floor
+    (BENCH_LINK_FIXED_MS, default 10) plus a per-byte cost
+    (BENCH_MESH_LINK_MB_PER_S, default 5) priced off the drained buffers
+    themselves — NOT off a global ledger delta, which would misattribute
+    bytes when four lane fetchers drain concurrently. That concurrency is
+    the whole claim: the single-queue arm pays the link serially in its
+    one fetcher; the lanes arm overlaps four drains, so the ratio
+    approaches the device count minus the shared-CPU compute floor.
+
+    Both arms prewarm their EXACT program sets first (the off arm via
+    warm_chain's default-device ladder, the lanes arm via
+    prewarm.warm_mesh_paths — per-lane pinned keys are per-DEVICE compile
+    cache entries) and the gate requires compile_misses == 0 in both: the
+    speedup must come from link overlap, not from one arm eating compiles.
+
+    Gates (exit nonzero on violation):
+      * lanes req/s >= 2.5x single-queue req/s at 4 devices;
+      * compile_misses == 0 in BOTH arms;
+      * every lane dispatched at least once (placement actually spreads).
+    """
+    import threading
+
+    import jax
+
+    from imaginary_tpu import prewarm
+    from imaginary_tpu.engine.executor import (Executor, ExecutorConfig,
+                                               batch_ladder)
+    from imaginary_tpu.options import ImageOptions
+    from imaginary_tpu.ops import chain as chain_mod
+    from imaginary_tpu.ops.plan import plan_operation
+
+    n_dev = len(jax.devices())
+    if n_dev < 4:
+        log("[dev] *** mesh A/B needs >= 4 devices; run under "
+            'XLA_FLAGS="--xla_force_host_platform_device_count=4" ***')
+        row = {"metric": "mesh_ab_lanes_vs_single",
+               "error": f"needs 4 devices, have {n_dev}"}
+        print(json.dumps(row), flush=True)
+        return [row], 1
+
+    total = int(os.environ.get("BENCH_MESH_ITEMS", "256"))
+    fixed_s = float(os.environ.get("BENCH_LINK_FIXED_MS", "10")) / 1000.0
+    bw = float(os.environ.get("BENCH_MESH_LINK_MB_PER_S", "3")) * 1e6
+    h, w, out_w = 256, 384, 192
+    max_batch = 16
+    opts = ImageOptions(width=out_w)
+    plan = plan_operation("resize", opts, h, w, 0, 3)
+    rng = np.random.default_rng(11)
+    arrs = [rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            for _ in range(16)]
+
+    real_fetch = chain_mod.fetch_groups
+
+    def paced_fetch(ys, device=None):
+        nbytes = sum(int(y.nbytes) for y in ys if y is not None)
+        out = real_fetch(ys, device=device)
+        time.sleep(fixed_s + nbytes / bw)
+        return out
+
+    def run_arm(policy: str) -> dict:
+        ex = Executor(ExecutorConfig(
+            mesh_policy=policy, n_devices=(4 if policy != "off" else None),
+            host_spill=False, max_batch=max_batch, max_inflight=8))
+        built = prewarm.warm_chain("resize", opts, h, w,
+                                   batch_ladder(max_batch))
+        built += prewarm.warm_mesh_paths(ex, "resize", opts, h, w,
+                                         batch_ladder(max_batch))
+        misses0 = ex.stats.compile_misses
+        done = threading.Semaphore(0)
+        futs = []
+        chain_mod.fetch_groups = paced_fetch
+        t0 = time.perf_counter()
+        try:
+            for i in range(total):
+                f = ex.submit(arrs[i % len(arrs)], plan)
+                f.add_done_callback(lambda _f: done.release())
+                futs.append(f)
+            for _ in futs:
+                done.acquire(timeout=60)
+        finally:
+            chain_mod.fetch_groups = real_fetch
+        elapsed = time.perf_counter() - t0
+        completed = sum(1 for f in futs
+                        if f.done() and not f.cancelled()
+                        and f.exception() is None)
+        misses = ex.stats.compile_misses - misses0
+        lanes = getattr(ex, "_lanes", None)
+        lane_dispatches = ([s["dispatches"] for s in lanes.snapshot()]
+                           if lanes is not None else [])
+        ex.shutdown()
+        arm = {
+            "policy": policy,
+            "items": total,
+            "completed": completed,
+            "elapsed_s": round(elapsed, 3),
+            "req_per_s": round(completed / elapsed, 1),
+            "compile_misses": misses,
+            "prewarmed": built,
+            "lane_dispatches": lane_dispatches,
+        }
+        log(f"[dev] mesh arm {policy:>5}: {arm['req_per_s']} req/s "
+            f"({completed}/{total} in {elapsed:.2f}s), {misses} compile "
+            f"misses, lane dispatches {lane_dispatches}")
+        return arm
+
+    log(f"[dev] mesh A/B: {n_dev} devices, {total} items, link "
+        f"{fixed_s * 1000:.0f} ms + {bw / 1e6:.0f} MB/s D2H")
+    single = run_arm("off")
+    lanes_arm = run_arm("lanes")
+
+    ratio = (lanes_arm["req_per_s"] / single["req_per_s"]
+             if single["req_per_s"] > 0 else 0.0)
+    ok = True
+    why = []
+    if ratio < 2.5:
+        ok = False
+        why.append(f"lanes/single ratio {ratio:.2f} < 2.5")
+    for arm in (single, lanes_arm):
+        if arm["compile_misses"] != 0:
+            ok = False
+            why.append(f"{arm['policy']} paid {arm['compile_misses']} "
+                       "post-prewarm compiles")
+        if arm["completed"] != arm["items"]:
+            ok = False
+            why.append(f"{arm['policy']} completed {arm['completed']}"
+                       f"/{arm['items']}")
+    if lanes_arm["lane_dispatches"] and \
+            not all(d > 0 for d in lanes_arm["lane_dispatches"]):
+        ok = False
+        why.append(f"idle lane: dispatches {lanes_arm['lane_dispatches']}")
+    row = {
+        "metric": "mesh_ab_lanes_vs_single",
+        "devices": n_dev,
+        "link_fixed_ms": fixed_s * 1000.0,
+        "link_mb_per_s": bw / 1e6,
+        "arms": [single, lanes_arm],
+        "throughput_ratio": round(ratio, 2),
+        "ok": ok,
+    }
+    print(json.dumps(row), flush=True)
+    if ok:
+        log(f"[dev] mesh A/B ok: {ratio:.2f}x at {n_dev} devices, zero "
+            "compile misses in both arms")
+    else:
+        log(f"[dev] *** mesh A/B FAILED: {'; '.join(why)} ***")
+    return [row], (0 if ok else 1)
+
+
 def transport_ab():
     """Raw-vs-compressed-domain transport A/B on the 1080p -> thumbnail
     ladder, under the measured-link simulation (BENCH_LINK_FIXED_MS per
@@ -663,6 +816,23 @@ def main():
             return 1
         log(f"[dev] tunnel bound flipped: link -> {flip[0]['bound_by']} "
             f"at {flip[0]['wire_mb_per_img']} MB/img measured")
+        return code
+
+    if os.environ.get("BENCH_MESH_AB") == "1":
+        # lanes-vs-single-queue multi-chip A/B (the third make
+        # bench-device gate row; needs 4 devices — the Makefile pins
+        # XLA_FLAGS=--xla_force_host_platform_device_count=4)
+        rows, code = mesh_ab()
+        os.makedirs("artifacts", exist_ok=True)
+        art = os.path.join("artifacts",
+                           f"mesh_ab_{jax.default_backend()}.jsonl")
+        with open(art, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        with open(os.path.join("artifacts", "MULTICHIP_r06.json"), "w") as f:
+            json.dump(rows[0], f, indent=2)
+            f.write("\n")
+        log(f"[dev] archived mesh A/B -> {art} + artifacts/MULTICHIP_r06.json")
         return code
 
     if os.environ.get("BENCH_AB") == "1":

@@ -397,6 +397,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spatial-threshold-px", type=int,
                    default=_env_int("IMAGINARY_TPU_SPATIAL_THRESHOLD_PX", 3840 * 2160),
                    help="bucket pixel count at which W-sharding engages")
+    p.add_argument("--mesh-policy",
+                   default=_env_str("IMAGINARY_TPU_MESH_POLICY", "off"),
+                   choices=["off", "lanes", "sharded", "auto"],
+                   help="multi-chip serving (engine/lanes.py): 'lanes' "
+                        "gives every healthy chip its own continuous-"
+                        "batching collector lane; 'sharded'/'auto' "
+                        "additionally stage big chunks batch-sharded "
+                        "over the healthy mesh; 'off' (default) is the "
+                        "single-lane parity path")
+    p.add_argument("--spatial-mpix", type=float,
+                   default=_env_float("IMAGINARY_TPU_SPATIAL_MPIX", 0.0),
+                   help="megapixel bar for the lane tier's oversize-"
+                        "single spatial route (maps onto "
+                        "--spatial-threshold-px; 0 keeps the pixel knob "
+                        "authoritative)")
+    p.add_argument("--lane-form-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_LANE_FORM_MS", -1.0),
+                   help="per-lane batch-formation cap in ms (negative = "
+                        "inherit --batch-form-ms)")
+    p.add_argument("--lane-inflight", type=int,
+                   default=_env_int("IMAGINARY_TPU_LANE_INFLIGHT", 2),
+                   help="per-lane launched-but-undrained group window "
+                        "(the lane's only backpressure)")
     p.add_argument("--host-spill",
                    default=_env_str("IMAGINARY_TPU_HOST_SPILL", "auto"),
                    choices=["auto", "on", "off"],
@@ -628,6 +651,10 @@ def options_from_args(args) -> ServerOptions:
         n_devices=args.devices or None,
         spatial=max(1, args.spatial),
         spatial_threshold_px=max(1, args.spatial_threshold_px),
+        mesh_policy=args.mesh_policy,
+        spatial_mpix=max(0.0, args.spatial_mpix),
+        lane_form_ms=args.lane_form_ms if args.lane_form_ms >= 0 else None,
+        lane_inflight=max(1, args.lane_inflight),
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         force_host=args.force_host,
         hedge_threshold_ms=max(0.0, args.hedge_threshold_ms),

@@ -197,6 +197,16 @@ class DeviceHealthRegistry:
         self._strikes: deque = deque(maxlen=64)
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_stop = threading.Event()
+        # Per-chip lane stats provider (engine/lanes.py, installed by the
+        # executor when mesh_policy arms the lane scheduler): snapshot()
+        # merges its output so /health's deviceHealth block carries lane
+        # depth + affinity alongside the breaker states — one block, one
+        # fault-domain story. None (the default) adds nothing: the
+        # single-lane snapshot stays byte-identical.
+        self._lane_stats_provider: Optional[Callable[[], list]] = None
+
+    def set_lane_stats_provider(self, fn: Optional[Callable[[], list]]) -> None:
+        self._lane_stats_provider = fn
 
     def configure_failslow(self, ratio: float, min_samples: int = 8,
                            share: float = 0.0, strikes: int = 8) -> None:
@@ -573,7 +583,7 @@ class DeviceHealthRegistry:
             per = [r.to_dict(now) for r in self._records]
         healthy = sum(1 for d in per if d["state"] == STATE_HEALTHY)
         quarantined = sum(1 for d in per if d["state"] == STATE_QUARANTINED)
-        return {
+        out = {
             "count": len(per),
             "healthy": healthy,
             "quarantined": quarantined,
@@ -581,6 +591,16 @@ class DeviceHealthRegistry:
             "corruptions": sum(d["corruptions"] for d in per),
             "per_device": per,
         }
+        provider = self._lane_stats_provider
+        if provider is not None:
+            try:
+                lanes = provider()
+            # itpu: allow[ITPU004] observability must not take down /health; the block is simply absent
+            except Exception:
+                lanes = None
+            if lanes:
+                out["lanes"] = lanes
+        return out
 
     # -- background probe --------------------------------------------------
 

@@ -41,8 +41,9 @@ import numpy as np
 
 from imaginary_tpu import failpoints
 from imaginary_tpu.engine import host_exec
+from imaginary_tpu.engine import lanes as lanes_mod
 from imaginary_tpu.engine.devhealth import DeviceHealthRegistry
-from imaginary_tpu.engine.timing import TIMES, WIRE
+from imaginary_tpu.engine.timing import LANE_TIMES, TIMES, WIRE
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
@@ -231,6 +232,35 @@ class ExecutorConfig:
     failslow_ratio: float = 0.0
     failslow_min_samples: int = 8
     failslow_share: float = 0.0
+    # Multi-chip sharded serving (engine/lanes.py). "off" (the default)
+    # is the parity path: no lane object is ever constructed and submit/
+    # collect/fetch are byte-identical to the single-lane build. "lanes"
+    # gives every healthy chip its own continuous-batching collector lane
+    # (own formation cap, own in-flight window, own drain thread) and
+    # places arrivals by (queue depth x EWMA service time) with device-
+    # frame-cache affinity. "sharded" additionally stages any formed
+    # chunk of >= shard_min_items with a batch-axis NamedSharding over
+    # the healthy mesh; "auto" behaves like "sharded" (the profitability
+    # threshold already routes small chunks to single lanes).
+    mesh_policy: str = "off"
+    # Oversize-single spatial route for the lane tier: a single-image
+    # enlarge whose bucket crosses this many MEGAPIXELS rides the
+    # ("batch","spatial") halo-exchange path instead of one chip. 0
+    # keeps spatial_threshold_px (the legacy pixel knob) authoritative.
+    spatial_mpix: float = 0.0
+    # Per-lane formation cap in ms; None inherits the continuous
+    # policy's cap (max_form_ms, else window_ms).
+    lane_form_ms: Optional[float] = None
+    # Per-lane in-flight window (chunks launched but not yet drained on
+    # that chip). The lane's bounded fetch queue enforces it: a full
+    # window blocks that lane's dispatch, queue depth grows, and the
+    # placement score steers new work to emptier lanes.
+    lane_inflight: int = 2
+    # Sharded-dispatch profitability threshold: chunks below this many
+    # items ride ONE lane (sharding a small batch pays collective +
+    # padding overhead for no per-chip win). 0 derives 2x the mesh
+    # batch axis, i.e. every chip gets >= 2 items before sharding.
+    shard_min_items: int = 0
 
 
 @dataclasses.dataclass
@@ -271,6 +301,13 @@ class ExecutorStats:
     host_ms_per_mpix: float = 0.0  # measured host CPU cost per megapixel
     host_inflight: int = 0  # spilled items executing on host threads right now
     host_owed_mpix: float = 0.0  # megapixels of in-flight host work (the pool's backlog)
+    # Lane tier (mesh_policy != "off"). lanes_snapshot is the scheduler's
+    # snapshot callable, installed by _init_lanes; None (parity) keeps
+    # every lane key out of to_dict so the off path serializes the seed's
+    # dict byte for byte. mesh_generation counts topology epochs
+    # (quarantine/re-admission), each one a single recompile.
+    lanes_snapshot: Optional[object] = None
+    mesh_generation: int = 0
 
     def to_dict(self) -> dict:
         # per-stage spill timing rides along so the p99 tail is
@@ -282,7 +319,7 @@ class ExecutorStats:
         form_times = snap.get("batch_form")
         disp_times = snap.get("dispatch_wait")
         donation = chain_mod.donation_stats()
-        return {
+        out = {
             "items": self.items,
             "batches": self.batches,
             "groups": self.groups,
@@ -339,6 +376,14 @@ class ExecutorStats:
             "wire_transfers": {"h2d": wire["h2d_transfers"],
                                "d2h": wire["d2h_transfers"]},
         }
+        if self.lanes_snapshot is not None:
+            lanes = self.lanes_snapshot()
+            if lanes:
+                out["lanes"] = lanes
+                out["mesh_generation"] = self.mesh_generation
+        if "by_device" in wire:
+            out["wire_bytes_by_device"] = wire["by_device"]
+        return out
 
 
 # Measured link seed, installed by prewarm (prewarm.py): (ms_per_mb,
@@ -401,7 +446,7 @@ def last_placement() -> Optional[str]:
 
 class _Item:
     __slots__ = ("arr", "plan", "future", "key", "t", "t_close", "wire_mb",
-                 "mpix", "qos", "trace")
+                 "mpix", "qos", "trace", "lane", "hops")
 
     def __init__(self, arr: np.ndarray, plan: ImagePlan):
         self.arr = arr
@@ -415,6 +460,12 @@ class _Item:
         # placement ladder (`placement_attempts`) is stamped through this
         # reference — per-request chip attribution, not batch-scoped.
         self.trace = None
+        # Lane-tier ownership (engine/lanes.py): the index of the lane
+        # currently owing this item's answer (set by _lane_owe, cleared
+        # by the future's done callback) and how many times quarantine/
+        # failure re-placement has bounced it between lanes.
+        self.lane = None
+        self.hops = 0
         if plan.in_bucket is not None:  # packed transport: pre-padded array
             hb, wb = plan.in_bucket
             in_h, in_w = plan.in_h, plan.in_w
@@ -452,6 +503,13 @@ class Executor:
         self.config = config or ExecutorConfig()
         if self.config.host_spill is None:
             self.config = dataclasses.replace(self.config, host_spill=True)
+        self._mesh_policy = (self.config.mesh_policy or "off").lower()
+        if self.config.spatial_mpix > 0.0:
+            # the lane tier's knob is in megapixels; it maps onto the
+            # existing pixel threshold so both routes share one bar
+            self.config = dataclasses.replace(
+                self.config,
+                spatial_threshold_px=int(self.config.spatial_mpix * 1e6))
         self.stats = ExecutorStats()
         if self.config.qos is not None:
             # class-aware intake (imaginary_tpu/qos/sched.py): same
@@ -467,7 +525,10 @@ class Executor:
         self._full_sharding = None  # pristine mesh sharding (no quarantines)
         self._mesh_batch = 1
         self._mesh_spatial = 1
-        if self.config.use_mesh:
+        # mesh_policy supersedes use_mesh: the lane tier owns the mesh
+        # when armed (use_mesh's single-collector sharding would fight
+        # the per-chip collectors for the same chips)
+        if self.config.use_mesh and self._mesh_policy == "off":
             from jax.sharding import NamedSharding, PartitionSpec
 
             from imaginary_tpu.parallel import batch_sharding, get_mesh
@@ -550,6 +611,17 @@ class Executor:
                 self.devhealth.start_probing(self._probe_device,
                                              timeout_s=self._probe_timeout_s())
         self._devhealth_gen = 0
+        # Lane-tier state (mesh_policy != "off"; engine/lanes.py). All
+        # None/zero on the parity path — submit() checks `_lanes is None`
+        # and everything below never runs.
+        self._lanes: Optional[lanes_mod.LaneScheduler] = None
+        self._lane_sharding = None  # batch-axis sharding over healthy mesh
+        self._lane_mesh_batch = 0  # healthy batch-axis size (pad multiple)
+        self._lane_spatial_full = None  # pristine spatial sharding (restore)
+        self._lane_spatial_batch = 1  # full-mesh batch axis (spatial pad)
+        self._lane_lock = threading.Lock()
+        self._lanes_devhealth_gen = 0
+        self._mesh_generation = 0
         # in-flight device items + live hedge count (the hedge budget's
         # denominator/numerator), guarded by _owed_lock
         self._device_items = 0
@@ -625,6 +697,8 @@ class Executor:
         # shared boolean a replacement fetcher would reset.
         self._drain_state = None
         self._fetch_gen = 0
+        if self._mesh_policy != "off":
+            self._init_lanes()
         self._thread = threading.Thread(target=self._collector, name="itpu-executor", daemon=True)
         self._thread.start()
         self._fetcher = threading.Thread(target=self._fetch_loop, name="itpu-fetcher",
@@ -698,6 +772,18 @@ class Executor:
             "host_owed_mpix": round(host_owed, 3),
             "host_gate_free_permits": getattr(self._host_gate, "_value", None),
         }
+        if self._lanes is not None:
+            # lane tier (engine/lanes.py): per-lane occupancy, affinity
+            # hit ratios, and the per-lane stage EWMAs — the "which chip
+            # is the convoy on" view
+            snap["lanes"] = {
+                "policy": self._mesh_policy,
+                "mesh_generation": self._mesh_generation,
+                "shard_min_items": (self._shard_min()
+                                    if self._lane_sharding is not None else 0),
+                "lanes": self._lanes.snapshot(),
+                "stage_times": LANE_TIMES.snapshot(),
+            }
         if self.config.qos is not None:
             # per-class intake depth (the fair scheduler's live view)
             snap["qos_queued"] = self._queue.depths()
@@ -850,6 +936,26 @@ class Executor:
                 self._host_release(item.mpix)
                 self._host_gate.release()
         self._charge_owed(item)
+        if self._lanes is not None:
+            # Lane tier: place on a per-chip collector lane by
+            # (queue depth x EWMA service time) with frame-cache
+            # affinity. place() returning None (every lane drained by
+            # quarantine) falls through to the legacy global queue —
+            # the device ladder + breaker + host rungs own the endgame,
+            # so a total lane outage degrades, never refuses.
+            lane = self._lanes.place(item)
+            if lane is not None:
+                lanes_mod._lane_owe(lane, item)
+                try:
+                    lane.put(item)
+                except Exception:
+                    item.future.cancel()
+                    raise
+                if self.config.hedge_threshold_ms > 0:
+                    outer = self._arm_hedge(item)
+                    if outer is not None:
+                        return outer
+                return item.future
         try:
             self._queue.put(item)
         except Exception:
@@ -1338,6 +1444,17 @@ class Executor:
         # final drain — a shutdown-enqueued sentinel could overtake batches
         # still being dispatched and strand their futures
         self._fetcher.join(timeout=30)
+        if self._lanes is not None:
+            for ln in self._lanes.lanes:
+                ln.queue.put(None)
+            for ln in self._lanes.lanes:
+                if ln.collector is not None:
+                    ln.collector.join(timeout=30)
+            # lane collectors enqueue their fetchers' sentinels after the
+            # final drain (same ordering reasoning as the global pair)
+            for ln in self._lanes.lanes:
+                if ln.fetcher is not None:
+                    ln.fetcher.join(timeout=30)
 
     # -- collector -------------------------------------------------------------
 
@@ -1510,18 +1627,25 @@ class Executor:
             arrs = arrs + [arrs[-1]] * (target - n)
             plans = plans + [plans[-1]] * (target - n)
         sharding = self._sharding
-        _, hb, wb, _c = items[0].key
-        if (
-            self._spatial_sharding is not None
-            and hb * wb >= self.config.spatial_threshold_px
-            # device_put rejects uneven sharding: W must split evenly
-            and wb % self._mesh_spatial == 0
-        ):
+        if self._spatial_route(items[0].key):
             sharding = self._spatial_sharding
             self.stats.spatial_batches += 1
         y = chain_mod.launch_batch(arrs, plans, sharding=sharding,
                                    device=device)
         return y, arrs, plans
+
+    def _spatial_route(self, key) -> bool:
+        """Oversize-image route decision, shared by the legacy mesh path
+        and the lane tier: the bucket crosses the spatial pixel bar
+        (spatial_threshold_px; --spatial-mpix maps onto it) AND W splits
+        evenly over the mesh's spatial axis (device_put rejects uneven
+        sharding). Degraded meshes clear _spatial_sharding, so chip loss
+        silently turns this route off rather than failing launches."""
+        if self._spatial_sharding is None:
+            return False
+        _, hb, wb, _c = key
+        return (hb * wb >= self.config.spatial_threshold_px
+                and wb % self._mesh_spatial == 0)
 
     def _refresh_mesh_sharding(self) -> None:
         """Mesh mode's quarantine story: when the registry's generation
@@ -1551,6 +1675,408 @@ class Executor:
         self._mesh_batch = m.devices.shape[0]
         self._mesh_spatial = 1
         self._spatial_sharding = None
+
+    # -- lane tier (engine/lanes.py; mesh_policy != "off") ---------------------
+
+    def _init_lanes(self) -> None:
+        """Arm per-chip continuous-batching lanes: one collector/fetcher
+        pair PER healthy chip (engine/lanes.py module docstring), so N
+        chips run N overlapped collect->launch->drain pipelines instead
+        of serializing through the global pair. The global collector and
+        fetcher stay running as the fallback tier — place() returning
+        None (all lanes quarantined) routes through them, and their
+        ladder (device failover, breaker, host) owns the endgame."""
+        from imaginary_tpu.parallel import (batch_sharding, get_mesh,
+                                            spatial_sharding)
+
+        mesh = get_mesh(self.config.n_devices, self.config.spatial,
+                        local=True)
+        self._mesh = mesh
+        self._devices = list(mesh.devices.flat)
+        self.devhealth.resize(len(self._devices))
+        if len(self._devices) > 1:
+            self.devhealth.start_probing(self._probe_device,
+                                         timeout_s=self._probe_timeout_s())
+        if self._mesh_policy in ("sharded", "auto"):
+            self._lane_sharding = batch_sharding(mesh)
+            self._lane_mesh_batch = mesh.devices.shape[0]
+        sp = spatial_sharding(mesh)
+        if sp is not None:
+            self._lane_spatial_full = sp
+            self._spatial_sharding = sp
+            self._mesh_spatial = mesh.devices.shape[1]
+        self._lane_spatial_batch = mesh.devices.shape[0]
+        # Epoch continuity: the compile-key generation (ops/chain.py) is
+        # process-global, so a new executor keys forward from wherever
+        # the last one left it — reusing an old epoch number could alias
+        # a DIFFERENT topology's sharded compile keys.
+        self._mesh_generation = chain_mod.mesh_generation()
+        self.stats.mesh_generation = self._mesh_generation
+        lanes = [lanes_mod.Lane(i, dev,
+                                max_inflight=self.config.lane_inflight)
+                 for i, dev in enumerate(self._devices)]
+        self._lanes = lanes_mod.LaneScheduler(lanes)
+        self._lanes_devhealth_gen = self.devhealth.generation
+        self.devhealth.set_lane_stats_provider(self._lanes.snapshot)
+        self.stats.lanes_snapshot = self._lanes.snapshot
+        for ln in lanes:
+            ln.collector = threading.Thread(
+                target=self._lane_collect, args=(ln,),
+                name=f"itpu-lane{ln.idx}", daemon=True)
+            ln.fetcher = threading.Thread(
+                target=self._lane_fetch, args=(ln,),
+                name=f"itpu-lane{ln.idx}-fetch", daemon=True)
+            ln.collector.start()
+            ln.fetcher.start()
+
+    def _lane_form_s(self) -> float:
+        """Per-lane formation cap: lane_form_ms when set, else the
+        continuous policy's cap (max_form_ms, else window_ms)."""
+        ms = self.config.lane_form_ms
+        if ms is None:
+            return self._form_cap_s()
+        return max(ms, 0.0) / 1000.0
+
+    def _shard_min(self) -> int:
+        """Sharded-dispatch profitability threshold (config docstring):
+        shard_min_items when set, else 2x the healthy batch axis so
+        every chip gets >= 2 items before a chunk pays collective +
+        padding overhead."""
+        m = self.config.shard_min_items
+        if m > 0:
+            return m
+        return max(2, 2 * max(1, self._lane_mesh_batch))
+
+    def _lane_collect(self, lane) -> None:
+        """One lane's collector: the continuous policy scoped to one
+        chip. The 50 ms idle poll doubles as the quarantine watch — a
+        devhealth generation change triggers the topology refresh, and a
+        deactivated lane drains everything it holds onto the survivors
+        before parking (it keeps polling so re-admission revives it
+        without a new thread)."""
+        form = self._lane_form_s()
+        pending: dict = {}  # key -> list[_Item]
+        last_gen = self._lanes_devhealth_gen
+        stop = False
+        while self._running and not stop:
+            timeout = 0.05
+            if pending:
+                oldest = min(items[0].t for items in pending.values())
+                timeout = max(0.0, min(
+                    timeout, oldest + form - time.monotonic()))
+            got = False
+            try:
+                got = lane.queue.get(timeout=timeout)
+            except queue_mod.Empty:
+                pass
+            if got is None:
+                break
+            if got is not False:
+                pending.setdefault(got.key, []).append(got)
+                while True:
+                    try:
+                        more = lane.queue.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if more is None:
+                        stop = True
+                        break
+                    pending.setdefault(more.key, []).append(more)
+            gen = self.devhealth.generation
+            if gen != last_gen:
+                last_gen = gen
+                self._refresh_lane_topology()
+            if not lane.active:
+                # drain-on-quarantine: everything formed or queued here
+                # re-places onto surviving lanes; items already launched
+                # drain (or fail over) through this lane's fetcher
+                drained = [it for items in pending.values() for it in items]
+                pending.clear()
+                while True:
+                    try:
+                        more = lane.queue.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    if more is None:
+                        stop = True
+                        break
+                    drained.append(more)
+                if drained:
+                    self._replace_lane_items(drained, exclude={lane.idx})
+                continue
+            now = time.monotonic()
+            due = [
+                k for k, items in pending.items()
+                if len(items) >= self.config.max_batch
+                or now - items[0].t >= form
+            ]
+            for k in due:
+                items = pending.pop(k)
+                for start in range(0, len(items), self.config.max_batch):
+                    chunk = items[start: start + self.config.max_batch]
+                    nowc = time.monotonic()
+                    for it in chunk:
+                        it.t_close = min(nowc, it.t + form)
+                    self._lane_dispatch(lane, chunk)
+        for items in pending.values():
+            for start in range(0, len(items), self.config.max_batch):
+                chunk = items[start: start + self.config.max_batch]
+                nowc = time.monotonic()
+                for it in chunk:
+                    it.t_close = min(nowc, it.t + form)
+                self._lane_dispatch(lane, chunk)
+        lane.fetch_queue.put(None)
+
+    def _lane_dispatch(self, lane, items: list) -> None:
+        """Launch one lane chunk. Route: mesh-sharded when the chunk
+        crosses the profitability threshold (sharded/auto policies),
+        spatial for an oversize single, else pinned to this lane's chip
+        with device-frame-cache keys (device_cache=True — PR 14's
+        zero-H2D repeats, now per chip). Failures strike THIS lane's
+        fault domain and the chunk re-places onto survivors."""
+        now = time.monotonic()
+        for it in items:
+            TIMES.record("queue_wait", (now - it.t) * 1000.0)
+            TIMES.record("batch_form", (it.t_close - it.t) * 1000.0)
+            TIMES.record("dispatch_wait", (now - it.t_close) * 1000.0)
+            LANE_TIMES.record(lane.idx, "batch_form",
+                              (it.t_close - it.t) * 1000.0)
+            LANE_TIMES.record(lane.idx, "dispatch_wait",
+                              (now - it.t_close) * 1000.0)
+        sharded = (self._lane_sharding is not None
+                   and len(items) >= self._shard_min())
+        spatial = (not sharded and len(items) == 1
+                   and self._spatial_route(items[0].key))
+        cache_before = chain_mod.cache_size()
+        t_launch = time.monotonic()
+        try:
+            failpoints.hit("device.chip_error", key=lane.idx)
+            failpoints.hit("device.oom", key=lane.idx)
+            failpoints.hit("device.slow", key=lane.idx)
+            if sharded:
+                y, arrs, plans = self._launch_lane_chunk(
+                    items, sharding=self._lane_sharding,
+                    mesh_mult=self._lane_mesh_batch)
+            elif spatial:
+                y, arrs, plans = self._launch_lane_chunk(
+                    items, sharding=self._spatial_sharding,
+                    mesh_mult=self._lane_spatial_batch)
+            else:
+                y, arrs, plans = self._launch_lane_chunk(
+                    items, device=lane.device)
+        except Exception as e:
+            if chain_mod.is_oom_error(e):
+                # capacity, not fault: bisect on the same placement
+                if sharded or spatial:
+                    self._bisect_chunk(items, None, None, e)
+                else:
+                    self._bisect_chunk(items, lane.device, lane.idx, e)
+                return
+            integ = self.integrity
+            if (not sharded and not spatial and integ is not None
+                    and integ.enabled and len(items) > 1
+                    and self._poison_bisect(items, lane.device, lane.idx, e)):
+                return
+            self._note_device_failure(lane.idx, e)
+            self._stamp_attempts(items, [f"device:{lane.idx}:error"])
+            self._replace_lane_items(items, exclude={lane.idx})
+            return
+        cold = chain_mod.cache_size() > cache_before
+        with self._owed_lock:
+            if cold:
+                self.stats.compile_misses += 1
+            if spatial:
+                self.stats.spatial_batches += 1
+            self.stats.items += len(items)
+            self.stats.groups += 1
+            self.stats.batches += 1
+            self.stats.max_group_seen = max(self.stats.max_group_seen,
+                                            len(items))
+        lane.dispatches += 1
+        self._stamp_attempts(
+            items, ["device:mesh:lane" if (sharded or spatial)
+                    else f"device:{lane.idx}:lane"])
+        # chunk tuple shape matches the global fetcher's (sub at [3],
+        # device idx at [4], t_launch at [5]) so the OOM/verify recovery
+        # helpers serve both paths; a full in-flight window blocks here —
+        # the lane's backpressure, surfacing as placement-score growth
+        lane.fetch_queue.put(
+            ((y, arrs, plans, items,
+              None if (sharded or spatial) else lane.idx, t_launch), cold))
+
+    def _launch_lane_chunk(self, items: list, sharding=None, device=None,
+                           mesh_mult: int = 1):
+        """Lane variant of _launch_chunk: pads to a power of two (and a
+        mesh-axis multiple when sharded) and opts device-pinned launches
+        into the per-device frame-cache keys (device_cache=True)."""
+        n = len(items)
+        arrs = [it.arr for it in items]
+        plans = [it.plan for it in items]
+        target = 1
+        while target < n:
+            target *= 2
+        if sharding is not None and mesh_mult > 1:
+            target = ((target + mesh_mult - 1) // mesh_mult) * mesh_mult
+        if target > n:
+            arrs = arrs + [arrs[-1]] * (target - n)
+            plans = plans + [plans[-1]] * (target - n)
+        y = chain_mod.launch_batch(arrs, plans, sharding=sharding,
+                                   device=device,
+                                   device_cache=device is not None)
+        return y, arrs, plans
+
+    def _refresh_lane_topology(self) -> None:
+        """Serialize topology transitions for the lane tier: called by
+        whichever lane collector first observes a devhealth generation
+        change. Re-derives every lane's active flag, rebuilds the
+        sharded-dispatch view over the survivors, drops (or restores)
+        the spatial route, and bumps the mesh generation — which is part
+        of every sharded compile key (ops/chain._sharding_cache_key), so
+        chip loss triggers exactly ONE recompile per shape, not one per
+        request."""
+        with self._lane_lock:
+            gen = self.devhealth.generation
+            if gen == self._lanes_devhealth_gen or self._lanes is None:
+                return
+            self._lanes_devhealth_gen = gen
+            avail = set(self.devhealth.available_indices())
+            full = len(avail) >= len(self._devices or ())
+            for ln in self._lanes.lanes:
+                ln.active = ln.idx in avail
+            if self._mesh is not None:
+                if full:
+                    if self._mesh_policy in ("sharded", "auto"):
+                        from imaginary_tpu.parallel import batch_sharding
+
+                        self._lane_sharding = batch_sharding(self._mesh)
+                        self._lane_mesh_batch = self._mesh.devices.shape[0]
+                    self._spatial_sharding = self._lane_spatial_full
+                    self._mesh_spatial = self._mesh.devices.shape[1]
+                else:
+                    # degraded: no W-sharding (healthy_mesh docstring)
+                    self._spatial_sharding = None
+                    if self._mesh_policy in ("sharded", "auto"):
+                        from imaginary_tpu.parallel.mesh import (
+                            batch_sharding, healthy_mesh)
+
+                        m = healthy_mesh(self._mesh, avail)
+                        if m is None:
+                            self._lane_sharding = None
+                        else:
+                            self._lane_sharding = batch_sharding(m)
+                            self._lane_mesh_batch = m.devices.shape[0]
+            self._mesh_generation += 1
+            self.stats.mesh_generation = self._mesh_generation
+            chain_mod.set_mesh_generation(self._mesh_generation)
+
+    def _replace_lane_items(self, items: list, exclude=()) -> None:
+        """Move still-live items onto surviving lanes (the lane rung of
+        the failover ladder). An item that exhausted its hop budget, or
+        when no lane survives, falls back to the GLOBAL intake queue —
+        the legacy per-device ladder with its breaker/host rungs owns
+        the endgame, so chip loss degrades capacity, never
+        availability."""
+        max_hops = 2 * max(1, len(self._lanes.lanes)) if self._lanes else 2
+        for it in items:
+            if it.future.done():
+                continue
+            it.hops += 1
+            lane = (self._lanes.place(it, exclude=exclude)
+                    if self._lanes is not None and it.hops <= max_hops
+                    else None)
+            if lane is None:
+                try:
+                    self._queue.put(it)
+                except Exception:
+                    it.future.cancel()
+                    raise
+                continue
+            lanes_mod._lane_owe(lane, it)
+            try:
+                lane.put(it)
+            except Exception:
+                it.future.cancel()
+                raise
+
+    def _lane_fetch(self, lane) -> None:
+        """One lane's fetcher: drain launched groups with coalescing,
+        exactly like the global fetch loop but scoped to one chip (and
+        booking D2H bytes against it). A failed drain strikes this
+        lane's fault domain and re-places the undone items — the
+        in-flight half of drain-on-quarantine."""
+        dkey = chain_mod._device_cache_key(lane.device)
+        while True:
+            got = lane.fetch_queue.get()
+            if got is None:
+                break
+            groups = [got]
+            sentinel = False
+            while True:
+                try:
+                    more = lane.fetch_queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if more is None:
+                    sentinel = True
+                    break
+                groups.append(more)
+            chunks = [g[0] for g in groups]
+            cold = any(g[1] for g in groups)
+            n_items = sum(len(c[3]) for c in chunks)
+            t0 = time.monotonic()
+            lanes_mod._lane_charge(lane, n_items)
+            try:
+                fetched = None
+                try:
+                    fetched = chain_mod.fetch_groups(
+                        [c[0] for c in chunks], device=dkey)
+                except Exception as e:
+                    if chain_mod.is_oom_error(e):
+                        for c in chunks:
+                            dev = lane.device if c[4] is not None else None
+                            self._recover_oom_chunk(c[3], dev, c[4], e)
+                    else:
+                        self._note_device_failure(lane.idx, e)
+                        live = [it for c in chunks for it in c[3]
+                                if not it.future.done()]
+                        if live:
+                            self._stamp_attempts(
+                                live, [f"device:{lane.idx}:drain_error"])
+                            self._replace_lane_items(
+                                live, exclude={lane.idx})
+                if fetched is not None:
+                    drain_ms = (time.monotonic() - t0) * 1000.0
+                    per_item = drain_ms / max(1, n_items)
+                    self._note_device_ok(lane.idx, latency_ms=drain_ms)
+                    lane.note_service(per_item)
+                    LANE_TIMES.record(lane.idx, "drain", per_item)
+                    for host_y, c in zip(fetched, chunks):
+                        _y, arrs, plans, sub, cidx, _tl = c
+                        try:
+                            outs = chain_mod.finish_batch(host_y, arrs, plans)
+                        except Exception as e:
+                            for it in sub:
+                                if not it.future.done():
+                                    it.future.set_exception(e)
+                            continue
+                        try:
+                            failpoints.hit("device.corrupt", key=lane.idx)
+                        except failpoints.FailpointError:
+                            from imaginary_tpu.engine import (
+                                integrity as integrity_mod)
+
+                            outs = [integrity_mod.corrupt_copy(o)
+                                    for o in outs]
+                        reserved = self._verify_chunk(sub, outs, cidx)
+                        for i, (it, out) in enumerate(zip(sub, outs)):
+                            if i in reserved:
+                                it.future._hedge_placement = "host"
+                            if not it.future.done():
+                                it.future.set_result(out)
+            finally:
+                lanes_mod._lane_release(lane, n_items)
+            if sentinel:
+                break
 
     def _launch_with_failover(self, sub: list):
         """The dispatch half of the placement ladder: device(n) →

@@ -125,28 +125,85 @@ class WireLedger:
         self._lock = threading.Lock()
         self._bytes = {"h2d": 0, "d2h": 0}
         self._transfers = {"h2d": 0, "d2h": 0}
+        # per-device attribution (multi-chip lanes): direction -> device
+        # label -> bytes. Only populated when a caller names a device —
+        # the single-lane path never does, so its snapshot (and /health)
+        # stays byte-identical to the pre-lanes build.
+        self._by_device: dict = {"h2d": {}, "d2h": {}}
 
-    def add(self, direction: str, nbytes: int) -> None:
+    def add(self, direction: str, nbytes: int, device=None) -> None:
         with self._lock:
             self._bytes[direction] += int(nbytes)
             self._transfers[direction] += 1
+            if device is not None:
+                dd = self._by_device[direction]
+                dd[str(device)] = dd.get(str(device), 0) + int(nbytes)
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "h2d": self._bytes["h2d"],
                 "d2h": self._bytes["d2h"],
                 "h2d_transfers": self._transfers["h2d"],
                 "d2h_transfers": self._transfers["d2h"],
             }
+            if self._by_device["h2d"] or self._by_device["d2h"]:
+                out["by_device"] = {
+                    "h2d": dict(self._by_device["h2d"]),
+                    "d2h": dict(self._by_device["d2h"]),
+                }
+            return out
 
     def reset(self) -> None:
         with self._lock:
             self._bytes = {"h2d": 0, "d2h": 0}
             self._transfers = {"h2d": 0, "d2h": 0}
+            self._by_device = {"h2d": {}, "d2h": {}}
 
 
 WIRE = WireLedger()
+
+
+class LaneStageTimes:
+    """Per-lane split of the executor stages (multi-chip lanes).
+
+    TIMES aggregates batch_form/dispatch_wait/drain fleet-wide; with one
+    lane per chip the actionable view is per LANE — a limping chip's
+    drain EWMA must not hide inside its healthy peers' average (the same
+    reasoning that moved the fail-slow latency booking per-chunk). Tiny
+    count+EWMA cells rather than full rings: /debugz wants a trend per
+    (lane, stage), not percentiles — the fleet percentiles stay in TIMES.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cells: dict = {}  # (lane, stage) -> [count, ewma_ms]
+
+    def record(self, lane: int, stage: str, ms: float) -> None:
+        with self._lock:
+            cell = self._cells.get((lane, stage))
+            if cell is None:
+                self._cells[(lane, stage)] = [1, ms]
+            else:
+                cell[0] += 1
+                cell[1] = 0.8 * cell[1] + 0.2 * ms
+
+    def snapshot(self) -> dict:
+        """{lane: {stage: {count, ewma_ms}}} — empty when no lane ever
+        recorded (the single-lane parity path)."""
+        with self._lock:
+            out: dict = {}
+            for (lane, stage), (count, ewma) in self._cells.items():
+                out.setdefault(lane, {})[stage] = {
+                    "count": count, "ewma_ms": round(ewma, 3)}
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells = {}
+
+
+LANE_TIMES = LaneStageTimes()
 
 _profiler_started = False
 _profiler_lock = threading.Lock()

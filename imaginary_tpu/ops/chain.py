@@ -117,12 +117,35 @@ def _run_chain(specs, x, h, w, dyns):
     return x, h, w
 
 
+# Mesh topology generation, bumped by the executor whenever the healthy
+# device set changes (quarantine or re-admission rebuilds the serving
+# mesh). Part of every SHARDED compile-cache key: two degraded meshes of
+# the same SHAPE but different surviving devices would otherwise share a
+# key, and jax's internal recompile for the new device set would be
+# booked as a warm cost-model sample — the exact mis-attribution ADVICE
+# r2 fixed for resharded relaunches. With the generation in the key,
+# chip loss recompiles ONCE per topology epoch (a detectable cache-size
+# bump), not silently per request. Stays 0 forever on the parity path.
+_MESH_GEN = 0
+
+
+def set_mesh_generation(gen: int) -> None:
+    global _MESH_GEN
+    _MESH_GEN = int(gen)
+
+
+def mesh_generation() -> int:
+    return _MESH_GEN
+
+
 def _sharding_cache_key(sharding):
     """Hashable descriptor of an input sharding. Part of the compile-cache
     key so the FIRST launch of a (signature, sharding) pair registers as a
     cache-size bump: the executor's cold-compile detector reads that bump,
     and a resharded relaunch recompiles inside jax.jit — without this it
-    would be booked as a warm cost-model sample (ADVICE r2)."""
+    would be booked as a warm cost-model sample (ADVICE r2). Carries the
+    mesh generation (set_mesh_generation) so each topology epoch keys —
+    and recompiles — exactly once."""
     if sharding is None:
         return None
     try:
@@ -130,6 +153,7 @@ def _sharding_cache_key(sharding):
             tuple(sharding.mesh.axis_names),
             tuple(sharding.mesh.devices.shape),
             str(sharding.spec),
+            _MESH_GEN,
         )
     except AttributeError:  # non-Named shardings: coarse but safe
         return repr(sharding)
@@ -219,26 +243,36 @@ def _stack_dyns(plans: list) -> tuple:
     return tuple(out)
 
 
-def _device_cached_parts(arrs, plans, dc) -> list:
+def _device_cached_parts(arrs, plans, dc, device=None) -> list:
     """Per-item staged device arrays, served from the device frame cache.
 
     A hit means the packed input never re-crosses the link; a miss stages
     that one item (booked to the wire ledger) and caches the resident
     buffer under the plan's frame_key. The key carries the packed dims, so
     a cached buffer always matches the batch geometry it joins.
+
+    `device` pins a lane-routed launch: the cache key grows the device
+    descriptor (a frame resident on chip K's HBM is useless to chip J's
+    launch — jnp.stack would drag it across ICI), misses stage onto that
+    chip, and the wire charge is attributed to it. The default path keys
+    and stages exactly as before.
     """
     parts = []
+    dkey = _device_cache_key(device)
     for a, p in zip(arrs, plans):
-        dev = dc.get(p.frame_key)
+        key = p.frame_key if dkey is None else (p.frame_key, dkey)
+        dev = dc.get(key)
         if dev is None:
-            WIRE.add("h2d", a.nbytes)
-            dev = jax.device_put(a)
-            dc.put(p.frame_key, dev, a.nbytes)
+            WIRE.add("h2d", a.nbytes, device=dkey)
+            dev = jax.device_put(a) if device is None \
+                else jax.device_put(a, device)
+            dc.put(key, dev, a.nbytes)
         parts.append(dev)
     return parts
 
 
-def launch_batch(arrs: list, plans: list, sharding=None, device=None):
+def launch_batch(arrs: list, plans: list, sharding=None, device=None,
+                 device_cache: bool = False):
     """Stage + dispatch one batched device call WITHOUT waiting for it.
 
     arrs: list of HWC uint8 arrays, all with the same bucket shape and C.
@@ -248,6 +282,11 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
     device: optional explicit jax.Device — inputs are placed there and the
     computation follows them (per-device fault-domain routing; mutually
     exclusive with sharding, which wins when both are given).
+    device_cache: opt-in (the lane dispatch path): let a device-pinned
+    launch use the device frame cache with per-device keys, so repeats
+    with lane affinity skip the H2D entirely. Off by default — the
+    legacy failover ladder bypasses the cache for pinned launches, and
+    that behavior must stay byte-identical when lanes are off.
     Returns the device output array (uint8, still computing), or None for an
     identity chain. JAX dispatch is async, so host->device transfer and
     compute proceed while the caller pipelines further batches; pair with
@@ -264,9 +303,9 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         # are NOT the array dims, they ride on the plan
         dc = _DEVICE_FRAMES
         if (dc is not None and dc.enabled and sharding is None
-                and device is None
+                and (device is None or device_cache)
                 and all(p.frame_key is not None for p in plans)):
-            dev_parts = _device_cached_parts(arrs, plans, dc)
+            dev_parts = _device_cached_parts(arrs, plans, dc, device=device)
         batch = None if dev_parts is not None else np.stack(arrs)
         in_shape = (len(arrs),) + tuple(arrs[0].shape)
         h = np.array([p.in_h for p in plans], dtype=np.int32)
@@ -317,11 +356,14 @@ def launch_batch(arrs: list, plans: list, sharding=None, device=None):
         # and the cached per-item arrays are never consumed.
         if dev_parts is not None:
             return jnp.stack(dev_parts)
-        WIRE.add("h2d", batch_host.nbytes)
         if sharding is not None:
+            WIRE.add("h2d", batch_host.nbytes, device="mesh")
             return jax.device_put(batch_host, sharding)
         if device is not None:
+            WIRE.add("h2d", batch_host.nbytes,
+                     device=_device_cache_key(device))
             return jax.device_put(batch_host, device)
+        WIRE.add("h2d", batch_host.nbytes)
         return jax.device_put(batch_host)
 
     donate = _DONATE
@@ -360,16 +402,18 @@ def ready_groups(ys: list) -> None:
             y.block_until_ready()
 
 
-def fetch_groups(ys: list) -> list:
+def fetch_groups(ys: list, device=None) -> list:
     """Drain several launch_batch outputs with ONE parallel device_get.
 
     The link's D2H path has a large fixed cost and benefits from concurrent
     per-buffer streams; device_get on the whole list overlaps them.
     Entries may be None (identity chains) and pass through unchanged.
+    `device` only attributes the wire charge (per-lane D2H accounting) —
+    the buffers already live where their launch placed them.
     """
     live = [y for y in ys if y is not None]
     if live:
-        WIRE.add("d2h", sum(int(y.nbytes) for y in live))
+        WIRE.add("d2h", sum(int(y.nbytes) for y in live), device=device)
         fetched = iter(jax.device_get(live))
         return [np.asarray(next(fetched)) if y is not None else None for y in ys]
     return [None] * len(ys)

@@ -13,6 +13,7 @@ from imaginary_tpu.parallel.mesh import (
     mesh_devices,
     pad_batch_for_mesh,
     replicated_sharding,
+    spatial_sharding,
 )
 
 __all__ = [
@@ -20,5 +21,6 @@ __all__ = [
     "mesh_devices",
     "batch_sharding",
     "replicated_sharding",
+    "spatial_sharding",
     "pad_batch_for_mesh",
 ]

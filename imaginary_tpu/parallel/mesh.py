@@ -114,6 +114,16 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def spatial_sharding(mesh: Mesh) -> Optional[NamedSharding]:
+    """(batch, H, W, C) with W split over the spatial axis — the serving
+    executor's oversize-single route (the partitioning the 8-device
+    dryrun validates numerically). None when the mesh has no spatial
+    axis to split over."""
+    if mesh.devices.shape[1] <= 1:
+        return None
+    return NamedSharding(mesh, PartitionSpec("batch", None, "spatial", None))
+
+
 def pad_batch_for_mesh(n: int, mesh: Mesh) -> int:
     """Round batch size up to a multiple of the batch axis."""
     b = mesh.devices.shape[0]

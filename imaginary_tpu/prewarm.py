@@ -204,6 +204,69 @@ def warm_chain(op: str, opts: ImageOptions, h: int, w: int,
     return built
 
 
+def warm_mesh_paths(ex, op: str, opts: ImageOptions, h: int, w: int,
+                    batch_sizes=None) -> int:
+    """Warm the LANE TIER's compile keys for one (op, options, dims)
+    combination on an executor with mesh_policy armed: the per-device
+    placement keys (one per lane — pinned launches key the compile cache
+    on _device_cache_key), the batch-axis sharded keys at every
+    mesh-multiple rung, and the oversize-single spatial key when that
+    route is live. Run AFTER warm_chain covers the unpinned keys; with
+    both, stats.compile_misses stays 0 across a multi-chip run exactly
+    as the single-lane prewarm contract promises (bench_device.py's mesh
+    A/B row asserts it on both arms). A topology change recompiles once
+    per shape by design — the mesh generation is part of the sharded
+    key, and warming future generations is unknowable. Returns the
+    number of programs built."""
+    from imaginary_tpu.engine.executor import batch_ladder
+
+    if getattr(ex, "_lanes", None) is None:
+        return 0
+    if batch_sizes is None:
+        batch_sizes = batch_ladder()
+    try:
+        plan = plan_operation(op, opts, h, w, 0, 3)
+    except Exception:
+        return 0
+    if not plan.stages:
+        return 0
+    arr = np.zeros((h, w, 3), dtype=np.uint8)
+    before = chain_mod.cache_size()
+    for ln in ex._lanes.lanes:
+        for b in batch_sizes:
+            try:
+                chain_mod.run_batch([arr] * b, [plan] * b, device=ln.device)
+            # itpu: allow[ITPU004] prewarm degrades, never dies before bind
+            except Exception:
+                continue
+    if ex._lane_sharding is not None:
+        m = max(1, ex._lane_mesh_batch)
+        seen_t = set()
+        for b in batch_sizes:
+            t = ((b + m - 1) // m) * m
+            if t in seen_t:
+                continue
+            seen_t.add(t)
+            try:
+                chain_mod.run_batch([arr] * t, [plan] * t,
+                                    sharding=ex._lane_sharding)
+            # itpu: allow[ITPU004] prewarm degrades, never dies before bind
+            except Exception:
+                continue
+    if ex._spatial_sharding is not None:
+        hb, wb = chain_mod.bucket_shape(h, w)
+        if (hb * wb >= ex.config.spatial_threshold_px
+                and wb % ex._mesh_spatial == 0):
+            t = max(1, ex._lane_spatial_batch)
+            try:
+                chain_mod.run_batch([arr] * t, [plan] * t,
+                                    sharding=ex._spatial_sharding)
+            # itpu: allow[ITPU004] prewarm degrades, never dies before bind
+            except Exception:
+                pass
+    return chain_mod.cache_size() - before
+
+
 def _dummy_input(pl, kind, dh, dw) -> np.ndarray:
     if kind == "yuv":
         ph, wb = pl.in_bucket

@@ -12,6 +12,7 @@ from imaginary_tpu.tools.rules import (
     context_propagation,
     failpoint_registry,
     future_guard,
+    lane_ledger,
     ledger,
     metrics_exposition,
     obs_registry,
@@ -23,6 +24,7 @@ RULES = (
     async_blocking,
     future_guard,
     ledger,
+    lane_ledger,
     silent_except,
     config_surface,
     failpoint_registry,

@@ -176,6 +176,16 @@ class ServerOptions:
     # mesh axis (default: 4K-class); mirrors ExecutorConfig — test_engine
     # pins the three definitions (here, CLI, executor) equal
     spatial_threshold_px: int = 3840 * 2160
+    # Multi-chip sharded serving (engine/lanes.py; mirrors ExecutorConfig):
+    # "off" is the single-lane parity path; "lanes" runs one continuous-
+    # batching collector lane per healthy chip; "sharded"/"auto" also
+    # stage big chunks batch-sharded over the healthy mesh.
+    mesh_policy: str = "off"
+    # Megapixel bar for the lane tier's oversize-single spatial route
+    # (maps onto spatial_threshold_px; 0 keeps the pixel knob authoritative).
+    spatial_mpix: float = 0.0
+    lane_form_ms: Optional[float] = None  # per-lane formation cap (None=inherit)
+    lane_inflight: int = 2  # per-lane launched-but-undrained window
     # host SIMD spill under link saturation: None = auto (spill only when the
     # host has spare cores), True/False force it. Spilled pixels come from the
     # host interpreter (same dims, PSNR-equivalent but not bit-identical);

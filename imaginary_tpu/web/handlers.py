@@ -176,6 +176,10 @@ class ImageService:
                 n_devices=o.n_devices,
                 spatial=o.spatial,
                 spatial_threshold_px=o.spatial_threshold_px,
+                mesh_policy=o.mesh_policy,
+                spatial_mpix=o.spatial_mpix,
+                lane_form_ms=o.lane_form_ms,
+                lane_inflight=o.lane_inflight,
                 host_spill=o.host_spill,
                 force_host=o.force_host,
                 hedge_threshold_ms=o.hedge_threshold_ms,

@@ -33,7 +33,7 @@ _EXEC_GAUGES = {
     "host_spill_p99_ms", "device_owed_mb",
     "batch_form_p50_ms", "batch_form_p99_ms",
     "dispatch_wait_p50_ms", "dispatch_wait_p99_ms",
-    "donation_enabled",
+    "donation_enabled", "mesh_generation",
 }
 _CACHE_GAUGES = {
     "result_items", "result_bytes", "frame_items", "frame_bytes",
@@ -86,6 +86,8 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
     qos_classes: dict = {}
     hedge_outcomes: dict = {}
     wire: dict = {}
+    lanes_list: list = []
+    wire_by_device: dict = {}
     device_health: dict = {}
     pressure: dict = {}
     integrity: dict = {}
@@ -111,6 +113,15 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                     # deferred: direction-labeled families (one family
                     # per unit, h2d/d2h as labels)
                     wire[k] = v
+                    continue
+                if k == "lanes" and isinstance(v, list):
+                    # deferred: lane-labeled families (per-chip serving
+                    # lanes, engine/lanes.py) — only present when
+                    # mesh_policy is armed
+                    lanes_list = v
+                    continue
+                if k == "wire_bytes_by_device" and isinstance(v, dict):
+                    wire_by_device = v
                     continue
                 mtype = "gauge" if k in _EXEC_GAUGES else "counter"
                 x.emit(f"imaginary_tpu_executor_{_snake(k)}", v, mtype=mtype,
@@ -204,6 +215,30 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                f'direction="{escape_label_value(direction)}"',
                mtype="counter",
                help_text="Device-link transfer operations by direction.")
+    for direction, per_dev in sorted(wire_by_device.items()):
+        for dev, v in sorted(per_dev.items()):
+            x.emit("imaginary_tpu_wire_device_bytes_total", v,
+                   f'direction="{escape_label_value(direction)}",'
+                   f'device="{escape_label_value(str(dev))}"',
+                   mtype="counter",
+                   help_text="Device-link bytes attributed to a specific "
+                             "chip (lane tier / per-device routing).")
+    # per-lane families, one loop per family so each family's samples
+    # stay contiguous (strict-exposition grouping)
+    for s in lanes_list:
+        x.emit("imaginary_tpu_lane_queued", s.get("queued", 0),
+               f'lane="{s.get("lane", 0)}"', mtype="gauge",
+               help_text="Items placed on this chip's lane and not yet "
+                         "inside a drain (engine/lanes.py).")
+    for s in lanes_list:
+        x.emit("imaginary_tpu_lane_inflight", s.get("inflight", 0),
+               f'lane="{s.get("lane", 0)}"', mtype="gauge",
+               help_text="Items inside the drain this lane's fetcher is "
+                         "blocked on right now.")
+    for s in lanes_list:
+        x.emit("imaginary_tpu_lane_dispatches_total", s.get("dispatches", 0),
+               f'lane="{s.get("lane", 0)}"', mtype="counter",
+               help_text="Device calls launched on this chip's lane.")
     if device_health:
         x.emit("imaginary_tpu_devices_healthy", device_health.get("healthy", 0),
                help_text="Dispatchable devices in the healthy state.")

@@ -154,6 +154,55 @@ class TestLedger:
         assert findings == []
 
 
+class TestLaneLedger:
+    def test_trips_lane_charge_without_finally(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def _lane_fetch(self, lane):\n"
+            "        _lane_charge(lane, 4)\n"
+            "        outs = self.drain(lane)\n"
+            "        _lane_release(lane, 4)\n"  # not in a finally
+            "        return outs\n"
+        )}, rules=["ITPU011"])
+        assert [f.line for f in findings] == [3]
+
+    def test_finally_release_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def _lane_fetch(self, lane):\n"
+            "        _lane_charge(lane, 4)\n"
+            "        try:\n"
+            "            return self.drain(lane)\n"
+            "        finally:\n"
+            "            _lane_release(lane, 4)\n"
+        )}, rules=["ITPU011"])
+        assert findings == []
+
+    def test_trips_owe_without_cancel(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item, lane):\n"
+            "        _lane_owe(lane, item)\n"
+            "        lane.put(item)\n"  # a raising put strands the charge
+            "        return item.future\n"
+        )}, rules=["ITPU011"])
+        assert [f.line for f in findings] == [3]
+
+    def test_cancel_on_enqueue_failure_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Ex:\n"
+            "    def submit(self, item, lane):\n"
+            "        _lane_owe(lane, item)\n"
+            "        try:\n"
+            "            lane.put(item)\n"
+            "        except Exception:\n"
+            "            item.future.cancel()\n"
+            "            raise\n"
+            "        return item.future\n"
+        )}, rules=["ITPU011"])
+        assert findings == []
+
+
 class TestSilentExcept:
     def test_trips_both_shapes(self, tmp_path):
         findings, _ = _scan(tmp_path, {"m.py": (
@@ -492,8 +541,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 10 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 10
+        # all 11 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 11
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

@@ -454,6 +454,55 @@ class TestMetricsExposition:
                        if n == "imaginary_tpu_backend_info")
         assert backend["backend"] == 'we\\"ird\\\\backend'
 
+    def test_lane_families_render_strict(self):
+        from imaginary_tpu.web.metrics import render_metrics
+
+        text = render_metrics({
+            "executor": {
+                "items": 24,
+                "batches": 6,
+                "mesh_generation": 2,
+                "lanes": [
+                    {"lane": 0, "queued": 3, "inflight": 1, "owed": 4,
+                     "ewma_ms": 2.5, "dispatches": 6, "active": True},
+                    {"lane": 1, "queued": 0, "inflight": 0, "owed": 0,
+                     "ewma_ms": 1.0, "dispatches": 9, "active": False},
+                ],
+                "wire_bytes_by_device": {
+                    "h2d": {"0": 4096, "1": 2048},
+                    "d2h": {"0": 1024},
+                },
+            },
+        })
+        types, samples = parse_exposition_strict(text)
+        assert types["imaginary_tpu_lane_queued"] == "gauge"
+        assert types["imaginary_tpu_lane_inflight"] == "gauge"
+        assert types["imaginary_tpu_lane_dispatches_total"] == "counter"
+        assert types["imaginary_tpu_executor_mesh_generation"] == "gauge"
+        assert types["imaginary_tpu_wire_device_bytes_total"] == "counter"
+        queued = {labels["lane"]: v for n, labels, v in samples
+                  if n == "imaginary_tpu_lane_queued"}
+        assert queued == {"0": 3.0, "1": 0.0}
+        disp = {labels["lane"]: v for n, labels, v in samples
+                if n == "imaginary_tpu_lane_dispatches_total"}
+        assert disp == {"0": 6.0, "1": 9.0}
+        wire = {(labels["direction"], labels["device"]): v
+                for n, labels, v in samples
+                if n == "imaginary_tpu_wire_device_bytes_total"}
+        assert wire[("h2d", "0")] == 4096.0
+        assert wire[("h2d", "1")] == 2048.0
+        assert wire[("d2h", "0")] == 1024.0
+
+    def test_lane_families_absent_when_policy_off(self):
+        from imaginary_tpu.web.metrics import render_metrics
+
+        # mesh_policy off: the executor block carries no lanes /
+        # wire_bytes_by_device keys, and no lane family may leak out
+        text = render_metrics({"executor": {"items": 24, "batches": 6}})
+        parse_exposition_strict(text)
+        assert "imaginary_tpu_lane_" not in text
+        assert "imaginary_tpu_wire_device_bytes_total" not in text
+
 
 # --- /debugz ------------------------------------------------------------------
 
