@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native test bench bench-cache bench-obs bench-deadline bench-qos bench-memory bench-device chaos serve clean gate lint check
+.PHONY: all native native-entropy dct-parity test bench bench-cache bench-obs bench-deadline bench-qos bench-memory bench-device chaos serve clean gate lint check
 
 all: native test
 
@@ -9,7 +9,7 @@ all: native test
 # the driver's entry + 8-device dryrun execute, bench.py emits its JSON
 # line, and the chaos drill holds its invariants (CPU fallback allowed —
 # the gate checks the machinery, not the chip).
-gate: lint test chaos
+gate: lint native-entropy dct-parity test chaos
 	python __graft_entry__.py
 	BENCH_DURATION=2 BENCH_THREADS=8 python bench.py || \
 	  { echo "bench.py failed - snapshot NOT green"; exit 1; }
@@ -83,6 +83,20 @@ lint: check
 native:
 	python -m imaginary_tpu.native.build
 
+# Entropy-codec kernel (codecs/jpeg_dct.py's native arm). Best-effort:
+# hosts without a C++ toolchain serve on the numpy/python arms, so a
+# failed build must not red the gate — the parity suite still runs.
+native-entropy:
+	python -m imaginary_tpu.native.build entropy || \
+	  echo "native-entropy: toolchain unavailable - numpy/python arms serve"
+
+# Decoder/encoder parity suite: every entropy arm (native when built,
+# numpy, python) must produce byte-identical coefficients over the
+# corpus, and the egress encoder must roundtrip exactly. Runs whether
+# or not the native kernel built — the pure arms are the oracle.
+dct-parity:
+	python -m pytest tests/test_dct_codec.py tests/test_dct.py -q -m 'not slow'
+
 test:
 	python -m pytest tests/ -x -q
 
@@ -147,4 +161,5 @@ serve:
 clean:
 	rm -f imaginary_tpu/native/_imaginary_codecs*.so
 	rm -f imaginary_tpu/native/_imaginary_resample*.so
+	rm -f imaginary_tpu/native/_imaginary_entropy*.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

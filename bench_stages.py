@@ -114,6 +114,41 @@ def main() -> None:
     ours["encode_enlarge_ms"] = _median_ms(
         lambda: codecs.encode(big, EncodeOptions(type=ImageType.JPEG)), n=20)
 
+    # ---- cache-hit serving byte-touch audit ------------------------------
+    # A fleet-cache hit must touch each served byte exactly ONCE (the
+    # defensive snapshot out of the mmap); the body handed to the response
+    # layer is a zero-copy view of that snapshot. bytes_copied is the
+    # tier's own ledger of real copies — pin the invariant here so a
+    # future "convenience" bytes() slice reintroducing the second copy
+    # fails the bench, not a profiler session.
+    import tempfile
+
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    shm_path = os.path.join(tempfile.mkdtemp(prefix="itpu-bench-shm-"), "shm")
+    shm = ShmCache(shm_path, create=True, size_mb=4.0, owner=True)
+    try:
+        ckey = b"K" * 32
+        cmeta = b"image/jpeg\n"
+        cbody = buf[:96 * 1024]  # shm entries are slot-capped at 128 KB
+        assert shm.put(ckey, cmeta, cbody), "cache-hit audit: deposit refused"
+        before = shm.stats.bytes_copied
+        hit = shm.get(ckey)
+        assert hit is not None, "cache-hit audit: deposit did not read back"
+        hmeta, hbody = hit
+        touched = shm.stats.bytes_copied - before
+        assert isinstance(hbody, memoryview), \
+            "cache-hit audit: body is not a zero-copy view"
+        assert bytes(hbody) == cbody and bytes(hmeta) == cmeta
+        assert touched == len(cmeta) + len(cbody), (
+            f"cache-hit audit: hit touched {touched} bytes for a "
+            f"{len(cmeta) + len(cbody)}-byte payload (expected exactly one "
+            "snapshot copy)")
+        ours["cache_hit_ms"] = _median_ms(lambda: shm.get(ckey), n=40)
+        ours["cache_hit_bytes_per_byte"] = 1.0
+    finally:
+        shm.close()
+
     # ---- cv2 baseline stages (same work split) ---------------------------
     data = np.frombuffer(buf, np.uint8)
     a = cv2.imdecode(data, cv2.IMREAD_COLOR)

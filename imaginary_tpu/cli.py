@@ -451,10 +451,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pre-compile common op chains")
     p.add_argument("--transport-dct", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_TRANSPORT_DCT"),
-                   help="serve 4:2:0 JPEG requests over the compressed-"
-                        "domain transport: host entropy decode ships DCT "
-                        "coefficients, the device runs the IDCT, and "
-                        "shrink-on-load folds in the DCT domain")
+                   help="serve baseline JPEG requests (4:2:0/4:2:2/4:4:4/"
+                        "grayscale) over the compressed-domain transport: "
+                        "host entropy decode ships DCT coefficients, the "
+                        "device runs the IDCT, and shrink-on-load folds in "
+                        "the DCT domain")
+    p.add_argument("--transport-dct-egress", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_TRANSPORT_DCT_EGRESS"),
+                   help="drain JPEG-bound dct-transport responses as "
+                        "quantized DCT coefficients: the device runs the "
+                        "forward DCT + quantization and the host only "
+                        "entropy-codes (requires --transport-dct)")
+    p.add_argument("--dct-native", choices=("auto", "native", "numpy", "python"),
+                   default=os.environ.get("IMAGINARY_TPU_DCT_NATIVE", "auto"),
+                   help="entropy-decoder arm for the dct transport: the "
+                        "native C kernel, the vectorized numpy bit-plane "
+                        "decoder, the pure-python oracle, or auto (native "
+                        "if built, numpy for restart-segmented scans, else "
+                        "python)")
     # content-addressed caching (imaginary_tpu/cache.py); every knob also
     # honors an IMAGINARY_TPU_CACHE_* env override and defaults OFF so the
     # uncached serving path stays byte-identical to the reference build
@@ -661,6 +675,8 @@ def options_from_args(args) -> ServerOptions:
         hedge_budget=min(1.0, max(0.0, args.hedge_budget)),
         prewarm=args.prewarm,
         transport_dct=args.transport_dct,
+        transport_dct_egress=args.transport_dct_egress,
+        dct_native=args.dct_native,
         cache_result_mb=max(0.0, args.cache_result_mb),
         cache_frame_mb=max(0.0, args.cache_frame_mb),
         cache_device_mb=max(0.0, args.cache_device_mb),

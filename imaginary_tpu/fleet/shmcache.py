@@ -111,6 +111,11 @@ class FleetStats:
     # it 0 so any future bypass of verify-before-serve trips the gate.
     corrupt_served: int = 0
     evictions: int = 0
+    # bytes the hit path actually copied out of the mmap (the one
+    # defensive snapshot per hit). The serving layer hands out views of
+    # that snapshot, so bytes_copied / hit-bytes-served == 1.0 is the
+    # zero-copy invariant bench_stages pins.
+    bytes_copied: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -356,7 +361,15 @@ class ShmCache:
                 finally:
                     self._unlock(idx)
                 meta = payload[:meta_len]
-                body = payload[meta_len:]
+                # zero-copy body: a view over the immutable snapshot, not
+                # a second allocation — for large bodies the hit path
+                # touches each byte exactly once (the snapshot above,
+                # which a concurrent ring overwrite makes unavoidable).
+                # Consumers (aiohttp payloads, LRU promotion, len()) all
+                # take bytes-likes; bytes_copied books the one real copy
+                # so the bench can pin the hit path's byte-touch count.
+                body = memoryview(payload)[meta_len:]
+                self.stats.bytes_copied += meta_len + body_len
                 if _checksum(key, epoch, meta, body) != csum:
                     self.stats.corrupt += 1
                     self._reclaim(idx)

@@ -29,8 +29,9 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 _CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17"]
 
 
-def _compile(out_name: str, extra: list, verbose: bool) -> str:
-    src = os.path.join(HERE, "codecs.cpp")
+def _compile(out_name: str, extra: list, verbose: bool,
+             src_name: str = "codecs.cpp") -> str:
+    src = os.path.join(HERE, src_name)
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(HERE, out_name + suffix)
     include = sysconfig.get_path("include")
@@ -60,9 +61,25 @@ def build_resample(verbose: bool = True) -> str:
     return _compile("_imaginary_resample", ["-DITPU_RESAMPLE_ONLY"], verbose)
 
 
+def build_entropy(verbose: bool = True) -> str:
+    """Dependency-free JPEG entropy scan codec (always buildable with g++).
+
+    Separate translation unit (entropy.cpp -> _imaginary_entropy) so hosts
+    without any codec dev headers still get the native Huffman decode the
+    dct transport leans on; codecs/jpeg_dct.py picks it up on next start."""
+    return _compile("_imaginary_entropy", [], verbose, src_name="entropy.cpp")
+
+
 def build_any(verbose: bool = True) -> str:
-    """Best available native module, most- to least-capable: full codecs,
-    codecs minus webp, else the resample-only module."""
+    """Best available native codec module, most- to least-capable: full
+    codecs, codecs minus webp, else the resample-only module. The entropy
+    module builds independently (it needs no codec headers at all)."""
+    try:
+        build_entropy(verbose)
+    except Exception as e:
+        if verbose:
+            print(f"entropy codec build failed ({e}); dct transport falls "
+                  "back to the python/numpy decoders", file=sys.stderr)
     try:
         return build(verbose)
     except Exception as e:
@@ -79,7 +96,11 @@ def build_any(verbose: bool = True) -> str:
 
 
 if __name__ == "__main__":
-    path = build_any()
+    only = sys.argv[1] if len(sys.argv) > 1 else ""
+    if only == "entropy":
+        path = build_entropy()
+    else:
+        path = build_any()
     sys.path.insert(0, HERE)
     name = os.path.basename(path).split(".")[0]
     __import__(name)  # smoke import

@@ -113,7 +113,13 @@ def _run_chain(specs, x, h, w, dyns):
     x = x.astype(jnp.float32)
     for spec, dyn in zip(specs, dyns):
         x, h, w = spec.apply(x, h, w, dyn)
-    x = jnp.clip(x + 0.5, 0.0, 255.0).astype(jnp.uint8)  # round-to-nearest
+    if specs and getattr(specs[-1], "out_dtype", None) == "int16":
+        # coefficient drain (ToDctSpec): signed quantized values, NOT
+        # pixels — the uint8 clamp below would destroy them. Static
+        # branch: specs is the jit static argument.
+        x = jnp.clip(jnp.round(x), -32768.0, 32767.0).astype(jnp.int16)
+    else:
+        x = jnp.clip(x + 0.5, 0.0, 255.0).astype(jnp.uint8)  # round-to-nearest
     return x, h, w
 
 
@@ -431,6 +437,20 @@ def finish_batch(host_y, arrs: list, plans: list) -> list:
     """
     if host_y is None:
         return [np.asarray(a) for a in arrs]
+    if getattr(plans[0], "egress", "") == "dct":
+        # compressed-domain egress: the chain ended in ToDctSpec, so the
+        # fetched buffer holds quantized int16 coefficient planes in the
+        # yuv420 packed layout. Re-block into MCU grids here; the host
+        # entropy encoder (codecs/jpeg_dct.encode_quantized) drains them.
+        from imaginary_tpu.codecs.jpeg_dct import unpack_dct_egress
+
+        out = []
+        for i, p in enumerate(plans):
+            hb, wb = p.out_bucket
+            out.append(
+                unpack_dct_egress(host_y[i], p.out_h, p.out_w, hb, wb,
+                                  p.egress_quality))
+        return out
     if plans[0].transport in ("yuv420", "dct"):
         # dct chains end in the same ToYuv420Spec repack, so both packed
         # transports slice planes out of the identical layout

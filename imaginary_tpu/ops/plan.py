@@ -74,6 +74,13 @@ class ImagePlan:
     frame_key: identity of the staged input for the device-resident frame
     cache ((content digest, shrink, transport, packed dims) — see
     cache.DeviceFrameCache). None means "don't device-cache this input".
+
+    egress: "" (pixel readback) or "dct" (the chain ends in ToDctSpec and
+    the readback is quantized int16 coefficient planes — finish_batch
+    re-blocks them into QuantizedBlocks for the host entropy encoder).
+    egress_quality: the JPEG quality the device quantized at (the encoder
+    writes the matching DQT); rides on the plan, not the spec, so the jit
+    key stays quality-independent.
     """
 
     stages: list
@@ -85,6 +92,8 @@ class ImagePlan:
     in_w: int = 0
     out_bucket: Optional[tuple] = None  # output Y bucket dims (hb, wb)
     frame_key: Optional[tuple] = None
+    egress: str = ""
+    egress_quality: int = 0
 
     def spec_key(self) -> tuple:
         return tuple(s.spec for s in self.stages)
@@ -123,8 +132,28 @@ def wrap_plan_yuv420(plan: ImagePlan, src_h: int, src_w: int) -> ImagePlan:
     )
 
 
+def dct_in_bucket(shrink: int, hb: int, wb: int, layout: str) -> tuple:
+    """Packed coefficient-array dims for one (shrink, layout) combination —
+    the single source of truth shared by wrap_plan_dct, the pipeline's
+    pack_dct padding, and prewarm's dummy inputs (they must agree exactly
+    or the warmed jit signature misses).
+
+    4:2:0 at full scale packs yuv420-style [hb + hb/2, wb, 1]; 4:2:2 at
+    full scale stacks chroma in a second full-height band [2*hb, wb, 1];
+    grayscale/4:4:4 and every shrunk scale fold into [hb, wb, C] (see
+    codecs/jpeg_dct.pack_dct for the channel counts).
+    """
+    if layout == "420" and shrink == 1:
+        return (hb + hb // 2, wb)
+    if layout == "422" and shrink == 1:
+        return (2 * hb, wb)
+    return (hb, wb)
+
+
 def wrap_plan_dct(plan: ImagePlan, src_h: int, src_w: int, shrink: int,
-                  frame_key: Optional[tuple] = None) -> ImagePlan:
+                  frame_key: Optional[tuple] = None,
+                  layout: str = "420", egress: str = "",
+                  egress_quality: int = 75) -> ImagePlan:
     """Re-express an RGB plan (planned at the SHRUNK dims) as a
     dct-transport plan.
 
@@ -140,30 +169,45 @@ def wrap_plan_dct(plan: ImagePlan, src_h: int, src_w: int, shrink: int,
     The coefficient bucket can exceed bucket_shape(shrunk dims) when the
     MCU-padded block grid crosses a ladder rung; a static ShrinkBucketSpec
     restores the exact mid-chain geometry the RGB plan was built against.
+
+    egress="dct" swaps the ToYuv420Spec repack for ToDctSpec: the chain
+    ends with a device-side forward DCT + quantization at egress_quality
+    and the readback is int16 coefficients for the host entropy encoder
+    (compressed domain in BOTH directions).
     """
-    from imaginary_tpu.ops.stages import FromDctSpec, ToYuv420Spec
+    from imaginary_tpu.ops.stages import FromDctSpec, ToDctSpec, ToYuv420Spec
 
     if not plan.stages:
         return plan
-    k, h2, w2, hb, wb = dct_packed_geometry(src_h, src_w, shrink)
-    stages = [StageInstance(FromDctSpec(hb, wb, k), {})]
+    k, h2, w2, hb, wb = dct_packed_geometry(src_h, src_w, shrink, layout)
+    stages = [StageInstance(FromDctSpec(hb, wb, k, layout), {})]
     bh2, bw2 = bucket_shape(h2, w2)
     if (hb, wb) != (bh2, bw2):
         stages.append(StageInstance(ShrinkBucketSpec(bh2, bw2), {}))
     out_hb, out_wb = _final_bucket(plan.stages, h2, w2)
-    stages = stages + plan.stages + [StageInstance(ToYuv420Spec(out_hb, out_wb), {})]
+    if egress == "dct":
+        from imaginary_tpu.codecs.jpeg_dct import quality_tables
+
+        qy, qc = quality_tables(int(egress_quality))
+        tail = StageInstance(
+            ToDctSpec(out_hb, out_wb),
+            {"qy": qy.astype(np.float32), "qc": qc.astype(np.float32)},
+        )
+    else:
+        tail = StageInstance(ToYuv420Spec(out_hb, out_wb), {})
+    stages = stages + plan.stages + [tail]
     return ImagePlan(
         stages=stages,
         out_h=plan.out_h,
         out_w=plan.out_w,
         transport="dct",
-        # full scale packs yuv420-style [hb + hb/2, wb, 1]; shrunk scales
-        # pack [hb, wb, 3] (chroma folded at 2k — see codecs/jpeg_dct.py)
-        in_bucket=(hb + hb // 2, wb) if shrink == 1 else (hb, wb),
+        in_bucket=dct_in_bucket(shrink, hb, wb, layout),
         in_h=h2,
         in_w=w2,
         out_bucket=(out_hb, out_wb),
         frame_key=frame_key,
+        egress=egress,
+        egress_quality=int(egress_quality),
     )
 
 

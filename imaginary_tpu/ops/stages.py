@@ -386,6 +386,19 @@ def _yuv420_to_rgb(y, u, v, h, w, hb: int, wb: int):
     return _ycc_to_rgb(y, up2(u) - 128.0, up2(v) - 128.0)
 
 
+def _yuv422_to_rgb(y, u, v, h, w, hb: int, wb: int):
+    """4:2:2 tail: chroma is full-height, half-width — one horizontal 2x
+    centered-triangle upsample, then BT.601 YCbCr -> RGB."""
+    cw = (w + 1) // 2
+
+    def up2w(plane):
+        j0, j1, s = _chroma_up_indices(wb, cw, wb // 2)
+        cols = jax.vmap(lambda p, a, b: (p[:, a], p[:, b]))(plane, j0, j1)
+        return cols[0] * (1.0 - s)[None, None, :] + cols[1] * s[None, None, :]
+
+    return _ycc_to_rgb(y, up2w(u) - 128.0, up2w(v) - 128.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class FromYuv420Spec:
     """Unpack the packed YUV420 transport buffer into RGB.
@@ -429,49 +442,78 @@ class FromDctSpec:
     """Scaled-IDCT the packed DCT-coefficient buffer into RGB.
 
     Input is *dequantized, frequency-folded coefficients* (int16 on the
-    wire, f32 by the time stages run) in the jpeg_dct packed layout. Two
-    static layouts, mirroring libjpeg's per-component scaled decode:
+    wire, f32 by the time stages run) in the jpeg_dct packed layout — one
+    static branch per (layout, k), mirroring libjpeg's per-component
+    scaled decode:
 
-    - k == 8 (full scale): x is [B, hb + hb/2, wb, 1], yuv420-style — Y
-      blocks in rows [0, hb), half-resolution chroma blocks below; the
-      8-point IDCT is followed by the shared fancy chroma upsample.
-    - k < 8 (shrink-on-load): x is [B, hb, wb, 3]. Y was folded to k x k
-      but chroma — stored at half resolution — folds only to 2k x 2k, so
-      after the per-channel IDCT all three planes land at the SAME output
-      resolution and no upsample runs at all. That is exactly what
-      libjpeg does (chroma DCT_scaled_size = 2x luma's), which is what
-      makes parity with the host decoder exact instead of filter-shaped.
+    - 420, k == 8: x is [B, hb + hb/2, wb, 1], yuv420-style — Y blocks in
+      rows [0, hb), half-resolution chroma blocks below; the 8-point IDCT
+      is followed by the shared fancy chroma upsample.
+    - 420, k < 8 (shrink-on-load): x is [B, hb, wb, 3]. Y was folded to
+      k x k but chroma — stored at half resolution — folds only to
+      2k x 2k, so after the per-channel IDCT all three planes land at the
+      SAME output resolution and no upsample runs at all. That is exactly
+      what libjpeg does (chroma DCT_scaled_size = 2x luma's), which is
+      what makes parity with the host decoder exact instead of
+      filter-shaped.
+    - 422, k == 8: x is [B, 2*hb, wb, 1] — Y above, half-width chroma
+      planes side by side below; one horizontal 2x upsample.
+    - 422, k < 8: x is [B, hb, wb, 3], chroma folded to k x 2k.
+    - 444 / gray: x is [B, hb, wb, 3] / [.., 1], every plane at k, no
+      upsample (gray broadcasts luma over RGB).
 
     One fused program from coefficients to RGB, with the host having done
     only the serial entropy decode and an exact integer dequantize/fold.
-    No dyn inputs: the compile cache sees only static (bucket, k) shapes.
+    No dyn inputs: the compile cache sees only static (bucket, k, layout)
+    shapes.
     """
 
     hb: int
     wb: int
     k: int
+    layout: str = "420"
 
     def apply(self, x, h, w, dyn):
         hb, wb, k = self.hb, self.wb, self.k
 
-        def idct(plane, kk, ph, pw):
-            basis = _idct_basis(kk)
-            blk = plane.reshape(-1, ph // kk, kk, pw // kk, kk)
+        def idct(plane, kv, kh, ph, pw):
+            bv = _idct_basis(kv)
+            bh = _idct_basis(kh)
+            blk = plane.reshape(-1, ph // kv, kv, pw // kh, kh)
             # f32 on purpose (vs _mm_dtype): dequantized coefficients reach
             # +-4k where bf16 resolves only +-16 — visible banding; the
             # contractions are k <= 8 wide, so MXU rate is not the limiter
-            out = jnp.einsum("brucv,ux,vz->brxcz", blk, basis, basis,
+            out = jnp.einsum("brucv,ux,vz->brxcz", blk, bv, bh,
                              preferred_element_type=jnp.float32)
             return out.reshape(-1, ph, pw) + 128.0
 
+        if self.layout == "gray":
+            y = idct(x[..., 0], k, k, hb, wb)
+            rgb = jnp.clip(jnp.stack([y, y, y], axis=-1), 0.0, 255.0)
+            return rgb, h, w
+        if self.layout == "444":
+            y = idct(x[..., 0], k, k, hb, wb)
+            uu = idct(x[..., 1], k, k, hb, wb) - 128.0
+            vv = idct(x[..., 2], k, k, hb, wb) - 128.0
+            return _ycc_to_rgb(y, uu, vv), h, w
+        if self.layout == "422":
+            if k == 8:
+                y = idct(x[:, :hb, :, 0], 8, 8, hb, wb)
+                u = idct(x[:, hb:, : wb // 2, 0], 8, 8, hb, wb // 2)
+                v = idct(x[:, hb:, wb // 2 :, 0], 8, 8, hb, wb // 2)
+                return _yuv422_to_rgb(y, u, v, h, w, hb, wb), h, w
+            y = idct(x[..., 0], k, k, hb, wb)
+            uu = idct(x[..., 1], k, 2 * k, hb, wb) - 128.0
+            vv = idct(x[..., 2], k, 2 * k, hb, wb) - 128.0
+            return _ycc_to_rgb(y, uu, vv), h, w
         if k == 8:
-            y = idct(x[:, :hb, :, 0], 8, hb, wb)
-            u = idct(x[:, hb:, : wb // 2, 0], 8, hb // 2, wb // 2)
-            v = idct(x[:, hb:, wb // 2 :, 0], 8, hb // 2, wb // 2)
+            y = idct(x[:, :hb, :, 0], 8, 8, hb, wb)
+            u = idct(x[:, hb:, : wb // 2, 0], 8, 8, hb // 2, wb // 2)
+            v = idct(x[:, hb:, wb // 2 :, 0], 8, 8, hb // 2, wb // 2)
             return _yuv420_to_rgb(y, u, v, h, w, hb, wb), h, w
-        y = idct(x[..., 0], k, hb, wb)
-        uu = idct(x[..., 1], 2 * k, hb, wb) - 128.0
-        vv = idct(x[..., 2], 2 * k, hb, wb) - 128.0
+        y = idct(x[..., 0], k, k, hb, wb)
+        uu = idct(x[..., 1], 2 * k, 2 * k, hb, wb) - 128.0
+        vv = idct(x[..., 2], 2 * k, 2 * k, hb, wb) - 128.0
         return _ycc_to_rgb(y, uu, vv), h, w
 
 
@@ -506,6 +548,76 @@ class ToYuv420Spec:
 
         bottom = jnp.concatenate([pool(cb), pool(cr)], axis=2)  # [B, hb/2, wb]
         packed = jnp.concatenate([y, bottom], axis=1)[..., None]
+        return packed, h, w
+
+
+@dataclasses.dataclass(frozen=True)
+class ToDctSpec:
+    """Forward-DCT + quantize RGB into the packed egress coefficient
+    buffer — the JPEG-bound drain counterpart of FromDctSpec.
+
+    Input x is [B, hb, wb, 3] RGB; output [B, hb + hb/2, wb, 1] of
+    *quantized* coefficients in the same yuv420-shaped packing
+    FromDctSpec(k=8) reads: block (i, j)'s coefficient (u, v) at row
+    i*8 + u, col j*8 + v of its plane, Y above, U|V below. The readback
+    drains int16 (see chain._run_chain's drain-dtype tail), and the host
+    only entropy-codes: codecs/jpeg_dct.unpack_dct_egress +
+    encode_quantized turn the buffer into a baseline 4:2:0 JPEG with the
+    SAME quality-scaled Annex K tables the quantizer divided by here.
+    The tables ride as dyn params (qy/qc, [8, 8] f32 per image), NOT as
+    a static field: quality varies per request, and baking it into the
+    jit key would break the prewarm contract (compile_misses == 0) for
+    every quality a warm pass didn't guess.
+
+    Edge handling: valid pixels replicate outward over the bucket padding
+    (clamped-index gathers) before the color convert, so edge blocks and
+    the 2x2 chroma pool see libjpeg-style replicate padding instead of
+    bucket garbage. hb/wb must be multiples of 16 (every tight_dim output
+    bucket is), keeping MCU rows block-aligned in the packed buffer.
+    """
+
+    hb: int
+    wb: int
+
+    # chain._run_chain reads this to drain rounded int16 coefficients
+    # instead of clamping to uint8 pixels
+    out_dtype = "int16"
+
+    def apply(self, x, h, w, dyn):
+        hb, wb = self.hb, self.wb
+        iy = jnp.minimum(jnp.arange(hb, dtype=jnp.int32)[None, :],
+                         jnp.maximum(h[:, None] - 1, 0))
+        ix = jnp.minimum(jnp.arange(wb, dtype=jnp.int32)[None, :],
+                         jnp.maximum(w[:, None] - 1, 0))
+
+        def replicate(img, ryy, rxx):
+            return img[ryy][:, rxx]
+
+        x = jax.vmap(replicate)(x, iy, ix)
+        x = jnp.clip(x, 0.0, 255.0)
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        y = 0.299 * r + 0.587 * g + 0.114 * b
+        cb = -0.168736 * r - 0.331264 * g + 0.5 * b + 128.0
+        cr = 0.5 * r - 0.418688 * g - 0.081312 * b + 128.0
+        cbp = cb.reshape(-1, hb // 2, 2, wb // 2, 2).mean(axis=(2, 4))
+        crp = cr.reshape(-1, hb // 2, 2, wb // 2, 2).mean(axis=(2, 4))
+        qy, qc = dyn["qy"], dyn["qc"]  # [B, 8, 8] quality-scaled steps
+
+        def fdct_q(plane, q, ph, pw):
+            basis = _idct_basis(8)
+            blk = plane.reshape(-1, ph // 8, 8, pw // 8, 8) - 128.0
+            # f32 throughout, like FromDctSpec: coefficient magnitudes
+            # dwarf bf16 resolution and the contraction is only 8 wide
+            coef = jnp.einsum("brxcz,ux,vz->brucv", blk, basis, basis,
+                              preferred_element_type=jnp.float32)
+            q = q.astype(jnp.float32)[:, None, :, None, :]
+            return jnp.round(coef / q).reshape(-1, ph, pw)
+
+        yq = fdct_q(y, qy, hb, wb)
+        uq = fdct_q(cbp, qc, hb // 2, wb // 2)
+        vq = fdct_q(crp, qc, hb // 2, wb // 2)
+        bottom = jnp.concatenate([uq, vq], axis=2)  # [B, hb/2, wb]
+        packed = jnp.concatenate([yq, bottom], axis=1)[..., None]
         return packed, h, w
 
 

@@ -68,6 +68,38 @@ def set_transport_dct(on: bool) -> None:
 def transport_dct_enabled() -> bool:
     return _TRANSPORT_DCT
 
+
+# Compressed-domain egress (--transport-dct-egress): the device chain ends
+# in a forward DCT + quantization (ops ToDctSpec) and the host entropy
+# encoder drains int16 coefficients instead of pixels — the link carries
+# quantized coefficients in BOTH directions. Rides on the dct transport
+# (requires --transport-dct) and is OFF by default for the same
+# byte-identical-off-state reason.
+_TRANSPORT_DCT_EGRESS = False
+
+
+def set_transport_dct_egress(on: bool) -> None:
+    """Flip dct egress on/off (wired from --transport-dct-egress)."""
+    global _TRANSPORT_DCT_EGRESS
+    _TRANSPORT_DCT_EGRESS = bool(on)
+
+
+def transport_dct_egress_enabled() -> bool:
+    return _TRANSPORT_DCT_EGRESS
+
+
+def _pick_egress(o: ImageOptions, target: ImageType) -> str:
+    """"dct" when this request should drain quantized coefficients.
+
+    Baseline-JPEG output only: encode_quantized writes baseline 4:2:0
+    scans, so progressive (interlace) requests keep the pixel readback
+    and the normal encoder."""
+    if not _TRANSPORT_DCT_EGRESS:
+        return ""
+    if target is not ImageType.JPEG or o.interlace:
+        return ""
+    return "dct"
+
 # Injected by the web layer: url -> RGBA ndarray (watermarkimage fetch,
 # image.go:343-370). Kept injectable so the ops layer stays network-free.
 WatermarkFetcher = Callable[[str], np.ndarray]
@@ -95,10 +127,12 @@ def _encode_type(o: ImageOptions, source: ImageType) -> ImageType:
 def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
     """Encode with the WEBP/HEIF/AVIF -> JPEG fallback (image.go:99-103).
 
-    arr is an HWC uint8 array, or YuvPlanes from the packed transport —
-    those encode through the raw-plane JPEG path (no host color math); a
-    non-JPEG target (mid-pipeline type switch) or raw-encode failure
-    converts the planes to RGB and takes the normal path.
+    arr is an HWC uint8 array, YuvPlanes from the packed transport (those
+    encode through the raw-plane JPEG path — no host color math), or
+    QuantizedBlocks from the dct egress (entropy-coded directly: the host
+    never touches pixels at all). A non-JPEG target (mid-pipeline type
+    switch) or raw-encode failure reconstructs pixels and takes the
+    normal path.
     """
     # last stage boundary before the response: a request whose budget
     # expired during device execute must not pay for an encode nobody
@@ -115,6 +149,19 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
         strip_metadata=o.strip_metadata,
     )
     t0 = time.monotonic()
+    from imaginary_tpu.codecs.jpeg_dct import QuantizedBlocks
+
+    if isinstance(arr, QuantizedBlocks):
+        if target is ImageType.JPEG and not o.interlace:
+            try:
+                body = codecs.jpeg_dct.encode_quantized(arr)
+                TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
+                return ProcessedImage(body=body,
+                                      mime=get_image_mime_type(target))
+            except ImageError:
+                pass  # fall through to the pixel reconstruction
+        y, u, v = codecs.jpeg_dct.blocks_to_planes(arr)
+        arr = YuvPlanes(y=y, u=u, v=v)
     if isinstance(arr, YuvPlanes):
         if target is ImageType.JPEG:
             try:
@@ -268,16 +315,17 @@ def process_operation(
 
 
 def _dct_eligible(src_type, meta, o: ImageOptions) -> bool:
-    """Gate for the compressed-domain transport: 4:2:0 JPEG in, JPEG out,
-    and the switch on. Coarser than the entropy decoder's own scope check
-    (baseline, 8-bit, no odd sampling factors) — decode_packed re-verifies
-    and returns None on anything it can't prove, falling back to yuv/rgb.
-    No native codec needed: the entropy decode is pure Python/numpy."""
+    """Gate for the compressed-domain transport: baseline JPEG in
+    (4:2:0/4:2:2/4:4:4/grayscale), JPEG out, and the switch on. Coarser
+    than the entropy decoder's own scope check (baseline, 8-bit, no odd
+    sampling factors) — decode_packed re-verifies and returns None on
+    anything it can't prove, falling back to yuv/rgb. No native codec
+    needed: the entropy decode falls back to pure Python/numpy."""
     if not _TRANSPORT_DCT:
         return False
     if src_type is not ImageType.JPEG or meta is None:
         return False
-    if meta.subsampling != "420":
+    if meta.subsampling not in ("420", "422", "444", "gray"):
         return False
     return o.type in _JPEG_TYPE_NAMES
 
@@ -354,14 +402,15 @@ def _decode_dct_packed(buf, shrink, frame_cache=None, digest=None):
     packed coefficient buffer caches under its own kind tag, and the same
     digest-scoped key doubles as the DEVICE frame-cache key (ops/chain.py
     pins the staged device buffer under it, so a hot source pays zero H2D
-    on repeat requests). Returns (packed, h2, w2, frame_key) or None."""
+    on repeat requests). Returns (packed, h2, w2, layout, frame_key) or
+    None."""
     key = None
     if frame_cache is not None and digest is not None:
         key = (digest, shrink, "dct")
         hit = frame_cache.get(key)
         if hit is not None:
-            packed, h2, w2 = hit
-            return packed, h2, w2, key
+            packed, h2, w2, layout = hit
+            return packed, h2, w2, layout, key
     t0 = time.monotonic()
     failpoints.hit("codec.decode")
     from imaginary_tpu.codecs import jpeg_dct
@@ -369,13 +418,13 @@ def _decode_dct_packed(buf, shrink, frame_cache=None, digest=None):
     got = jpeg_dct.decode_packed(buf, shrink)
     if got is None:
         return None
-    packed, h2, w2 = got
+    packed, h2, w2, layout = got
     TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
     fkey = (digest, shrink, "dct") if digest is not None else None
     if key is not None:
         packed.setflags(write=False)
-        frame_cache.put(key, (packed, h2, w2), packed.nbytes)
-    return packed, h2, w2, fkey
+        frame_cache.put(key, (packed, h2, w2, layout), packed.nbytes)
+    return packed, h2, w2, layout, fkey
 
 
 def _process_dct(name, buf, o, meta, shrink, watermark_fetcher, runner,
@@ -395,7 +444,7 @@ def _process_dct(name, buf, o, meta, shrink, watermark_fetcher, runner,
     got = _decode_dct_packed(buf, shrink, frame_cache, source_digest)
     if got is None:
         return None
-    packed, h2, w2, fkey = got
+    packed, h2, w2, layout, fkey = got
     if (h2, w2) != (sh, sw):
         return None
     wm = _fetch_watermark(name, o, watermark_fetcher)
@@ -403,10 +452,13 @@ def _process_dct(name, buf, o, meta, shrink, watermark_fetcher, runner,
                           watermark_rgba=wm)
     if not plan.stages:
         return None
+    target = _encode_type(o, ImageType.JPEG)
     wrapped = wrap_plan_dct(plan, meta.height, meta.width, shrink,
-                            frame_key=fkey)
+                            frame_key=fkey, layout=layout,
+                            egress=_pick_egress(o, target),
+                            egress_quality=o.quality if o.quality > 0 else 80)
     result = _run_stages(packed, wrapped, runner)
-    out = _encode(result, o, _encode_type(o, ImageType.JPEG))
+    out = _encode(result, o, target)
     return _carry_metadata(buf, o.strip_metadata, out, not o.no_rotation,
                            plan.out_w, plan.out_h)
 
@@ -522,15 +574,18 @@ def process_pipeline(
         sw = -(-meta.width // shrink)
         got = _decode_dct_packed(buf, shrink, frame_cache, source_digest)
         if got is not None and (got[1], got[2]) == (sh, sw):
-            packed, _h2, _w2, fkey = got
+            packed, _h2, _w2, layout, fkey = got
             combined, final_o, target, rotated, strip = _build_pipeline_plan(
                 o, sh, sw, meta.orientation, 3, ImageType.JPEG, watermark_fetcher
             )
             # identity chains fall through: the yuv path below serves them
             # straight from raw planes with no device round-trip at all
             if combined.stages:
+                q = final_o.quality if final_o.quality > 0 else 80
                 wrapped = wrap_plan_dct(combined, meta.height, meta.width,
-                                        shrink, frame_key=fkey)
+                                        shrink, frame_key=fkey, layout=layout,
+                                        egress=_pick_egress(final_o, target),
+                                        egress_quality=q)
                 result = _run_stages(packed, wrapped, runner)
                 out = _encode(result, final_o, target)
                 return _carry_metadata(buf, strip, out, rotated,

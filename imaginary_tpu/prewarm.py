@@ -187,6 +187,19 @@ def warm_chain(op: str, opts: ImageOptions, h: int, w: int,
             from imaginary_tpu.ops.plan import wrap_plan_dct
 
             plans.append((wrap_plan_dct(plan, h, w, dshrink), "dct"))
+            try:
+                from imaginary_tpu import pipeline as pipeline_mod
+
+                warm_egress = pipeline_mod.transport_dct_egress_enabled()
+            except Exception:
+                warm_egress = False
+            if warm_egress:
+                # egress chains end in ToDctSpec instead of ToYuv420Spec —
+                # a distinct program per chain. Quality rides as dyn
+                # (quantizer tables), so one warm covers every quality.
+                plans.append((wrap_plan_dct(plan, h, w, dshrink,
+                                            egress="dct", egress_quality=80),
+                              "dct"))
         for pl, kind in plans:
             for b in batch_sizes:
                 key = (pl.spec_key(), chain_mod.bucket_shape(dh, dw), b)
@@ -272,11 +285,16 @@ def _dummy_input(pl, kind, dh, dw) -> np.ndarray:
         ph, wb = pl.in_bucket
         return np.zeros((ph, wb, 1), dtype=np.uint8)
     if kind == "dct":
-        # full-scale packs Y+U+V into one int16 plane (yuv420-style rows);
-        # shrunk scales channel-pack Y/U/V folded coefficients
+        # full-scale 420/422 pack Y+U+V into one int16 plane (stacked
+        # rows) and grayscale is single-plane at any scale; every other
+        # (layout, scale) channel-packs Y/U/V folded coefficients — must
+        # mirror codecs/jpeg_dct.pack_dct exactly or the warmed jit
+        # signature misses
         ph, wb = pl.in_bucket
-        ch = 1 if pl.stages[0].spec.k == 8 else 3
-        return np.zeros((ph, wb, ch), dtype=np.int16)
+        spec = pl.stages[0].spec
+        layout = getattr(spec, "layout", "420")
+        one = layout == "gray" or (layout in ("420", "422") and spec.k == 8)
+        return np.zeros((ph, wb, 1 if one else 3), dtype=np.int16)
     return np.zeros((dh, dw, 3), dtype=np.uint8)
 
 

@@ -210,6 +210,16 @@ class ServerOptions:
     # convert, with shrink-on-load folded in the DCT domain. OFF by
     # default (parity: responses stay byte-identical when off).
     transport_dct: bool = False
+    # compressed-domain egress: JPEG-bound dct-transport responses drain
+    # quantized int16 coefficients (device forward DCT + quantization,
+    # host entropy encode only). Rides on transport_dct; OFF by default
+    # for the same byte-parity reason.
+    transport_dct_egress: bool = False
+    # entropy-decoder arm for the dct transport: "auto" picks the native
+    # C kernel when built, the numpy lockstep decoder for restart-
+    # segmented scans, else the pure-python oracle. "native"/"numpy"/
+    # "python" pin an arm (native falls back to python when not built).
+    dct_native: str = "auto"
     # --- content-addressed caching (imaginary_tpu/cache.py) ------------------
     # All tiers default OFF: with every knob at 0/False the serving path is
     # byte-identical to the uncached build (PARITY.md "Cache semantics").

@@ -134,6 +134,14 @@ class ImageService:
         from imaginary_tpu import pipeline as pipeline_mod
 
         pipeline_mod.set_transport_dct(o.transport_dct)
+        pipeline_mod.set_transport_dct_egress(
+            o.transport_dct and o.transport_dct_egress)
+        # entropy-decoder arm + segment fan-out pool (codecs/jpeg_dct.py):
+        # restart-segmented scans split across the handler pool, so the
+        # decode parallelism rides the same threads the host codecs use
+        from imaginary_tpu.codecs import jpeg_dct as jpeg_dct_mod
+
+        jpeg_dct_mod.set_decoder(o.dct_native)
         from imaginary_tpu.ops import chain as dev_chain_mod
 
         if o.cache_device_mb > 0:
@@ -197,6 +205,11 @@ class ImageService:
         workers = o.cpus if o.cpus > 0 else max(4, _available_cpus())
         self.pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="itpu-host")
         self._pool_workers = workers
+        # restart-segmented entropy decodes fan out across this same pool
+        # (jpeg_dct._run_scan runs chunk 0 inline and reclaims queued
+        # chunks on contention, so sharing the request pool cannot
+        # deadlock it)
+        jpeg_dct_mod.set_segment_pool(self.pool)
         # admission-control state (--max-queue-ms): in-flight host tasks
         # and an EWMA of per-request host service time feed the queue-delay
         # estimate; GCRA caps the RATE, this caps the queue DEPTH an
@@ -574,7 +587,9 @@ class ImageService:
             headers["ETag"] = etag
         if o.return_size and out.mime != "application/json":
             try:
-                m = codecs.probe(out.body)
+                # cache hits may carry a memoryview body (zero-copy shm
+                # serving); the header probe needs real bytes
+                m = codecs.probe(bytes(out.body))
                 headers["Image-Width"] = str(m.width)
                 headers["Image-Height"] = str(m.height)
             except ImageError:

@@ -136,10 +136,17 @@ class TestDecodeParity:
         b = io.BytesIO()
         im.save(b, "JPEG", quality=85, subsampling=2, progressive=True)
         assert jpeg_dct.decode_packed(b.getvalue(), 1) is None
-        # 4:4:4 is out of the 4:2:0-only scope
+        # 4:4:4 joined the decoder's scope (gray/444/422/420 all ride);
+        # verify it decodes and self-identifies
         b2 = io.BytesIO()
         im.save(b2, "JPEG", quality=85, subsampling=0)
-        assert jpeg_dct.decode_packed(b2.getvalue(), 1) is None
+        got = jpeg_dct.decode_packed(b2.getvalue(), 1)
+        assert got is not None
+        assert got[3] == "444"
+        # arithmetic-coded and CMYK streams stay out of scope
+        b3 = io.BytesIO()
+        im.convert("CMYK").save(b3, "JPEG", quality=85)
+        assert jpeg_dct.decode_packed(b3.getvalue(), 1) is None
 
 
 class TestEndToEnd:

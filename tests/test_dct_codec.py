@@ -1,0 +1,388 @@
+"""Fast compressed-domain codec tests (ISSUE 16 surface).
+
+Covers the entropy-coding layer both directions and the widened layout
+scope: encode -> decode coefficient identity (our own decoder AND a
+stdlib/libjpeg decode of the emitted stream), RST-segment parallel
+decode == serial byte-for-byte, native/numpy/python decoder arm parity
+over the corpus, gray/4:4:4/4:2:2 decode parity vs PIL draft mode at
+every shrink, the device DCT egress end-to-end path, egress prewarm
+coverage (compile_misses stays 0 for arbitrary request quality — the
+quantizer tables ride as dyn parameters), and the off-by-default pins
+for the new switches.
+
+Parity notes: 4:2:2 at shrink > 1 folds chroma at 2k horizontally while
+libjpeg's scaled decode runs its h2v1 upsample after the reduced IDCT;
+the filters differ at hard chroma edges, so the folded 4:2:2 rows pin
+mean error tightly but allow localized maxima (measured max 82, far
+inside the dual integrity tolerance of 96). Every other layout/shrink
+cell measures max <= 3.
+"""
+
+import io
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from imaginary_tpu import pipeline
+from imaginary_tpu.codecs import jpeg_dct
+from imaginary_tpu.engine.timing import WIRE
+from imaginary_tpu.options import ImageOptions
+from imaginary_tpu.ops import chain as chain_mod
+from imaginary_tpu.ops.buckets import dct_packed_geometry
+from imaginary_tpu.ops.plan import ImagePlan, StageInstance, dct_in_bucket
+from imaginary_tpu.ops.stages import FromDctSpec
+from tests.conftest import fixture_bytes
+
+CORPUS = ["imaginary.jpg", "medium.jpg", "large.jpg", "smart-crop.jpg",
+          "exif-orient-6.jpg"]
+SHRINKS = [1, 2, 4, 8]
+LAYOUTS = ["gray", "420", "422", "444"]
+_SUBSAMPLING = {"444": 0, "422": 1, "420": 2}
+
+
+@pytest.fixture(autouse=True)
+def _reset_transport(testdata):
+    yield
+    pipeline.set_transport_dct(False)
+    pipeline.set_transport_dct_egress(False)
+    jpeg_dct.set_decoder("auto")
+    jpeg_dct.set_segment_pool(None)
+
+
+def _reencoded(layout: str, quality: int = 88) -> bytes:
+    im = Image.open(io.BytesIO(fixture_bytes("medium.jpg"))).convert("RGB")
+    b = io.BytesIO()
+    if layout == "gray":
+        im.convert("L").save(b, "JPEG", quality=quality)
+    else:
+        im.save(b, "JPEG", quality=quality,
+                subsampling=_SUBSAMPLING[layout])
+    return b.getvalue()
+
+
+def _pil_draft_rgb(buf: bytes, shrink: int) -> np.ndarray:
+    im = Image.open(io.BytesIO(buf))
+    if shrink > 1:
+        im.draft(im.mode if im.mode == "L" else "RGB",
+                 (im.width // shrink, im.height // shrink))
+    return np.asarray(im.convert("RGB"))
+
+
+def _device_decode_rgb(buf: bytes, shrink: int) -> np.ndarray:
+    got = jpeg_dct.decode_packed(buf, shrink)
+    assert got is not None
+    packed, h2, w2, layout = got
+    with Image.open(io.BytesIO(buf)) as im:
+        src_w, src_h = im.size
+    k, _, _, hb, wb = dct_packed_geometry(src_h, src_w, shrink, layout)
+    plan = ImagePlan(
+        stages=[StageInstance(FromDctSpec(hb, wb, k, layout), {})],
+        out_h=h2, out_w=w2, transport="rgb",
+        in_bucket=dct_in_bucket(shrink, hb, wb, layout),
+        in_h=h2, in_w=w2, out_bucket=(hb, wb),
+    )
+    return np.asarray(chain_mod.run_single(packed, plan))
+
+
+def _natural_quantized_blocks(quality: int = 85):
+    """QuantizedBlocks carrying a real image's coefficients: PIL encodes
+    with libjpeg's quality-scaled tables, which quality_tables replays
+    exactly, so the grids slot straight into the egress container."""
+    buf = _reencoded("420", quality)
+    c = jpeg_dct.decode_coefficients(buf)
+    assert c is not None and c.layout == "420"
+    qy, qc = jpeg_dct.quality_tables(quality)
+    assert np.array_equal(c.qy.astype(np.int32), qy)
+    assert np.array_equal(c.qc.astype(np.int32), qc)
+    return buf, jpeg_dct.QuantizedBlocks(
+        h=c.h, w=c.w, quality=quality,
+        y=c.planes[0], u=c.planes[1], v=c.planes[2])
+
+
+def _random_quantized_blocks(h: int = 117, w: int = 203, seed: int = 3):
+    """Odd-dimension grids with every-category coefficients. Random
+    coefficients are out of gamut for pixel comparisons (libjpeg's
+    range-limit differs from a pure clip) but exercise the entropy
+    coder's full symbol alphabet — use for coefficient identity only."""
+    rng = np.random.default_rng(seed)
+    my, mx = -(-h // 16), -(-w // 16)
+
+    def blocks(br, bc, dc):
+        a = rng.integers(-7, 8, (br, bc, 8, 8)).astype(np.int16)
+        a[..., 0, 0] = rng.integers(-dc, dc, (br, bc))
+        return a
+
+    return jpeg_dct.QuantizedBlocks(
+        h=h, w=w, quality=77, y=blocks(2 * my, 2 * mx, 100),
+        u=blocks(my, mx, 60), v=blocks(my, mx, 60))
+
+
+def _planes_equal(planes, qb) -> bool:
+    return all(np.array_equal(a, b)
+               for a, b in zip(planes, (qb.y, qb.u, qb.v)))
+
+
+class TestEncoderRoundtrip:
+    def test_coefficient_identity_random(self):
+        # encode -> our own entropy decode -> the exact same int16 grids
+        qb = _random_quantized_blocks()
+        c = jpeg_dct.decode_coefficients(jpeg_dct.encode_quantized(qb))
+        assert c is not None and c.layout == "420"
+        assert (c.h, c.w) == (qb.h, qb.w)
+        assert _planes_equal(c.planes, qb)
+        qy, qc = jpeg_dct.quality_tables(qb.quality)
+        assert np.array_equal(c.qy.astype(np.int32), qy)
+        assert np.array_equal(c.qc.astype(np.int32), qc)
+
+    def test_stdlib_decode_pixel_identity(self):
+        # natural coefficients re-emitted through our encoder must decode
+        # (by libjpeg itself) to the *identical* pixels as the source
+        # stream: same coefficients + same DQT => same IDCT output
+        src, qb = _natural_quantized_blocks()
+        body = jpeg_dct.encode_quantized(qb)
+        a = np.asarray(Image.open(io.BytesIO(src)).convert("RGB"))
+        b = np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
+        assert np.array_equal(a, b)
+
+    def test_rst_emission_roundtrips_on_every_arm(self):
+        qb = _random_quantized_blocks()
+        body = jpeg_dct.encode_quantized(qb, restart_interval=2)
+        assert b"\xff\xdd" in body  # DRI present
+        arms = ["python", "numpy"]
+        if jpeg_dct.native_available():
+            arms.append("native")
+        for arm in arms:
+            c = jpeg_dct.decode_coefficients(body, decoder=arm)
+            assert c is not None and _planes_equal(c.planes, qb), arm
+
+    def test_python_encoder_parity(self):
+        # the native encode_segments kernel and the pure-Python encoder
+        # must emit byte-identical scans (the python arm is the oracle)
+        if not jpeg_dct.native_available():
+            pytest.skip("native entropy kernel not built")
+        qb = _random_quantized_blocks(seed=11)
+        saved = jpeg_dct._entropy
+        try:
+            native = [jpeg_dct.encode_quantized(qb),
+                      jpeg_dct.encode_quantized(qb, restart_interval=3)]
+            jpeg_dct._entropy = None
+            python = [jpeg_dct.encode_quantized(qb),
+                      jpeg_dct.encode_quantized(qb, restart_interval=3)]
+        finally:
+            jpeg_dct._entropy = saved
+        assert native == python
+
+
+class TestDecoderArms:
+    @pytest.mark.parametrize("name", CORPUS)
+    def test_arm_parity_on_corpus(self, name):
+        buf = fixture_bytes(name)
+        ref = jpeg_dct.decode_coefficients(buf, decoder="python")
+        assert ref is not None
+        for arm in ("numpy",) + (("native",)
+                                 if jpeg_dct.native_available() else ()):
+            got = jpeg_dct.decode_coefficients(buf, decoder=arm)
+            assert got is not None, arm
+            assert got.layout == ref.layout and (got.h, got.w) == (ref.h, ref.w)
+            for a, b in zip(got.planes, ref.planes):
+                assert np.array_equal(a, b), f"{name}/{arm}"
+
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    def test_arm_parity_on_layouts(self, layout):
+        buf = _reencoded(layout)
+        ref = jpeg_dct.decode_coefficients(buf, decoder="python")
+        assert ref is not None and ref.layout == layout
+        for arm in ("numpy",) + (("native",)
+                                 if jpeg_dct.native_available() else ()):
+            got = jpeg_dct.decode_coefficients(buf, decoder=arm)
+            assert got is not None
+            for a, b in zip(got.planes, ref.planes):
+                assert np.array_equal(a, b), f"{layout}/{arm}"
+
+    def test_segment_pool_fanout_matches_serial(self):
+        # a DRI stream decoded with the handler pool attached must yield
+        # byte-for-byte the serial result (DC prediction resets at RSTn
+        # make segments independent; the pool must not reorder rows)
+        qb = _random_quantized_blocks(h=160, w=240, seed=5)
+        body = jpeg_dct.encode_quantized(qb, restart_interval=1)
+        serial = jpeg_dct.decode_coefficients(body, decoder="python")
+        assert serial is not None and _planes_equal(serial.planes, qb)
+        pool = ThreadPoolExecutor(4)
+        try:
+            jpeg_dct.set_segment_pool(pool)
+            pooled = jpeg_dct.decode_coefficients(body, decoder="python")
+        finally:
+            jpeg_dct.set_segment_pool(None)
+            pool.shutdown()
+        assert pooled is not None
+        for a, b in zip(pooled.planes, serial.planes):
+            assert np.array_equal(a, b)
+
+    def test_decoder_mode_switch(self):
+        jpeg_dct.set_decoder("python")
+        assert jpeg_dct.decoder_name() == "python"
+        jpeg_dct.set_decoder("auto")
+        expect = "native" if jpeg_dct.native_available() else "python"
+        assert jpeg_dct.decoder_name(1) == expect
+        assert jpeg_dct.decoder_name(64) in ("native", "numpy")
+        with pytest.raises(ValueError):
+            jpeg_dct.set_decoder("turbo")
+
+
+class TestLayoutParity:
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("shrink", SHRINKS)
+    def test_layout_parity_vs_libjpeg(self, layout, shrink):
+        buf = _reencoded(layout)
+        got = _device_decode_rgb(buf, shrink)
+        ref = _pil_draft_rgb(buf, shrink)
+        assert got.shape == ref.shape
+        d = np.abs(got.astype(np.int16) - ref.astype(np.int16))
+        if layout == "422" and shrink > 1:
+            # folded chroma (2k) vs libjpeg's post-IDCT h2v1 upsample:
+            # hard chroma edges differ locally; mean stays tight and the
+            # max sits far inside the integrity tolerance (96)
+            assert int(d.max()) <= 96 and float(d.mean()) <= 4.0
+        else:
+            assert int(d.max()) <= 8, f"{layout} 1/{shrink}: max {d.max()}"
+            assert float(d.mean()) <= 2.0
+
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    def test_layout_end_to_end(self, layout):
+        buf = _reencoded(layout)
+        o = ImageOptions(width=160)
+        pipeline.set_transport_dct(False)
+        off = pipeline.process_operation("resize", buf, o)
+        pipeline.set_transport_dct(True)
+        on = pipeline.process_operation("resize", buf, o)
+        assert on.mime == off.mime == "image/jpeg"
+        a = np.asarray(Image.open(io.BytesIO(off.body)).convert("RGB"))
+        b = np.asarray(Image.open(io.BytesIO(on.body)).convert("RGB"))
+        assert a.shape == b.shape
+        from imaginary_tpu.engine.integrity import outputs_match
+
+        assert outputs_match(b, a, exact=False)
+
+
+class TestDctEgress:
+    def _serve(self, buf, o, egress: bool):
+        pipeline.set_transport_dct(True)
+        pipeline.set_transport_dct_egress(egress)
+        try:
+            return pipeline.process_operation("resize", buf, o)
+        finally:
+            pipeline.set_transport_dct_egress(False)
+
+    def test_egress_end_to_end_parity(self):
+        buf = fixture_bytes("medium.jpg")
+        o = ImageOptions(width=160)
+        off = self._serve(buf, o, egress=False)
+        w0 = WIRE.snapshot()
+        on = self._serve(buf, o, egress=True)
+        w1 = WIRE.snapshot()
+        assert on.mime == off.mime == "image/jpeg"
+        # the int16 coefficient drain is booked like any other d2h
+        assert w1["d2h"] > w0["d2h"]
+        a = np.asarray(Image.open(io.BytesIO(off.body)).convert("RGB"))
+        b = np.asarray(Image.open(io.BytesIO(on.body)).convert("RGB"))
+        assert a.shape == b.shape
+        from imaginary_tpu.engine.integrity import outputs_match
+
+        assert outputs_match(b, a, exact=False)
+
+    def test_egress_stream_is_baseline_jfif(self):
+        body = self._serve(fixture_bytes("medium.jpg"),
+                           ImageOptions(width=160, quality=72),
+                           egress=True).body
+        # our own ingest decoder accepts the emitted stream, and the DQT
+        # carries the request's quality tables
+        c = jpeg_dct.decode_coefficients(bytes(body))
+        assert c is not None and c.layout == "420"
+        qy, _ = jpeg_dct.quality_tables(72)
+        assert np.array_equal(c.qy.astype(np.int32), qy)
+
+    def test_egress_respects_non_jpeg_target(self):
+        out = self._serve(fixture_bytes("medium.jpg"),
+                          ImageOptions(width=120, type="png"), egress=True)
+        assert out.mime == "image/png"
+
+    def test_egress_quality_sweep_decodes(self):
+        buf = fixture_bytes("imaginary.jpg")
+        for q in (35, 60, 90):
+            out = self._serve(buf, ImageOptions(width=100, quality=q),
+                              egress=True)
+            im = Image.open(io.BytesIO(bytes(out.body)))
+            im.load()
+            assert im.size[0] == 100
+
+    def test_egress_prewarm_keeps_compile_misses_zero(self):
+        # quality rides as dyn quantizer tables, so ONE warmed program
+        # must cover any request quality — warm at the default, serve a
+        # different quality, and the compile ledger must stay clean
+        from imaginary_tpu import prewarm
+        from imaginary_tpu.engine.executor import Executor, ExecutorConfig
+        from imaginary_tpu.ops.plan import (
+            choose_decode_shrink,
+            plan_operation,
+            wrap_plan_dct,
+        )
+
+        pipeline.set_transport_dct(True)
+        pipeline.set_transport_dct_egress(True)
+        try:
+            o = ImageOptions(width=120)
+            built = prewarm.warm_chain("resize", o, 300, 400, (1,))
+            assert built >= 3  # rgb + dct ingest + dct egress programs
+            buf = fixture_bytes("exif-orient-6.jpg")
+            c = jpeg_dct.decode_coefficients(buf)
+            shrink = choose_decode_shrink("resize", o, c.h, c.w, 0, 3)
+            packed = jpeg_dct.pack_dct(c, shrink)
+            _, h2, w2, _, _ = dct_packed_geometry(c.h, c.w, shrink)
+            plan = plan_operation("resize", o, h2, w2, 0, 3)
+            wrapped = wrap_plan_dct(plan, c.h, c.w, shrink,
+                                    egress="dct", egress_quality=63)
+            ex = Executor(ExecutorConfig())
+            try:
+                out = ex.process(packed, wrapped)
+                assert isinstance(out, jpeg_dct.QuantizedBlocks)
+                assert out.quality == 63
+                assert ex.stats.to_dict()["compile_misses"] == 0
+            finally:
+                ex.shutdown()
+        finally:
+            pipeline.set_transport_dct_egress(False)
+
+
+class TestOffByDefault:
+    def test_new_switches_default_off(self):
+        assert pipeline.transport_dct_egress_enabled() is False
+        from imaginary_tpu.web.config import ServerOptions
+
+        o = ServerOptions()
+        assert o.transport_dct_egress is False
+        assert o.dct_native == "auto"
+
+    def test_egress_off_never_consults_encoder(self, monkeypatch):
+        # byte parity pin: with the egress switch off the quantized-blocks
+        # path is never entered, so responses are bit-for-bit the
+        # ingest-only build's
+        pipeline.set_transport_dct(True)
+        monkeypatch.setattr(
+            jpeg_dct, "unpack_dct_egress",
+            lambda *_a, **_k: pytest.fail("egress unpack ran with switch off"))
+        monkeypatch.setattr(
+            jpeg_dct, "encode_quantized",
+            lambda *_a, **_k: pytest.fail("egress encode ran with switch off"))
+        out = pipeline.process_operation(
+            "resize", fixture_bytes("medium.jpg"), ImageOptions(width=100))
+        assert out.mime == "image/jpeg"
+
+    def test_egress_off_responses_deterministic(self):
+        pipeline.set_transport_dct(True)
+        buf = fixture_bytes("imaginary.jpg")
+        o = ImageOptions(width=120)
+        a = pipeline.process_operation("resize", buf, o)
+        b = pipeline.process_operation("resize", buf, o)
+        assert a.body == b.body
