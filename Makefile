@@ -1,6 +1,6 @@
 # imaginary-tpu build/test targets (role of the reference's Makefile)
 
-.PHONY: all native native-entropy dct-parity test bench bench-cache bench-obs bench-deadline bench-qos bench-memory bench-device chaos serve clean gate lint check
+.PHONY: all native native-entropy dct-parity test bench bench-cache bench-obs bench-deadline bench-qos bench-memory bench-device bench-stages chaos serve clean gate lint check
 
 all: native test
 
@@ -25,7 +25,9 @@ gate: lint native-entropy dct-parity test chaos
 	  { echo "bench_memory.py failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=4 BENCH_THREADS=8 BENCH_AB=1 BENCH_PLATFORM=cpu python bench_device.py || \
 	  { echo "bench_device.py policy A/B failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device benches all pass"
+	BENCH_PLATFORM=cpu python bench_stages.py || \
+	  { echo "bench_stages.py byte-touch/spill gates failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device/stages benches all pass"
 
 # Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10 + ISSUE 11): the
 # deadline/failpoint/devhealth/pressure/integrity/fleet suites, then
@@ -148,9 +150,20 @@ bench-device:
 # bomb + oversize-enlarge firehose, governor on vs off: the governed arm
 # must hold >=95% well-formed availability (only 200/413/503/504) with
 # peak RSS under the configured ceiling; the ungoverned arm must exceed
-# that ceiling (BENCH_RSS_CEILING_MB tunes it)
+# that ceiling (BENCH_RSS_CEILING_MB tunes it); governed/ungoverned RSS
+# peaks archive to artifacts/memory_firehose.json with a delta vs the
+# previous run (regressions past +16 MB fail)
 bench-memory:
 	python bench_memory.py
+
+# per-stage host-ceiling decomposition + the byte-touch ledger rows:
+# end-to-end ns/byte and copies-per-request through the real app, the
+# cache-hit audit gated on copies-per-hit == 1 on BOTH tiers (local LRU
+# and fleet shm), and the spill-path dct shrink-on-load row gated >=2x
+# over full-scale reconstruction. Archives artifacts/host_ceiling_*.json
+# and artifacts/host_bytes_*.json.
+bench-stages:
+	BENCH_PLATFORM=cpu python bench_stages.py
 
 docker:
 	docker build -t imaginary-tpu .

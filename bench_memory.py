@@ -187,9 +187,40 @@ def main() -> int:
         "counts_off": {str(k): v
                        for k, v in sorted(off["counts"].items(), key=str)},
     }
+    # archive the governed/ungoverned RSS ceilings; when a previous run's
+    # artifact exists, the delta rides along so an RSS regression shows up
+    # as a diff in review, not as an incident. The governed peak is
+    # additionally gated against the previous run (+16 MB sampling slack).
+    os.makedirs("artifacts", exist_ok=True)
+    apath = os.path.join("artifacts", "memory_firehose.json")
+    prev = None
+    if os.path.exists(apath):
+        try:
+            with open(apath) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = None
+    if prev is not None:
+        row["prev_peak_rss_mb_governor_on"] = prev.get(
+            "peak_rss_mb_governor_on")
+        row["prev_peak_rss_mb_governor_off"] = prev.get(
+            "peak_rss_mb_governor_off")
+        if isinstance(row["prev_peak_rss_mb_governor_on"], (int, float)):
+            row["delta_peak_rss_mb_governor_on"] = round(
+                row["peak_rss_mb_governor_on"]
+                - row["prev_peak_rss_mb_governor_on"], 1)
+    with open(apath, "w") as f:
+        json.dump(row, f, indent=1)
+    print(f"[memory] wrote {apath}", file=sys.stderr)
     print(json.dumps(row))
 
     fails = []
+    prev_on = row.get("prev_peak_rss_mb_governor_on")
+    if isinstance(prev_on, (int, float)) and \
+            row["peak_rss_mb_governor_on"] > prev_on + 16.0:
+        fails.append(
+            f"governed peak RSS {row['peak_rss_mb_governor_on']:.0f} MB "
+            f"regressed past the previous run's {prev_on:.0f} MB")
     if on_total == 0:
         fails.append("governed arm produced zero requests")
     if on_total and allowed / on_total < 0.95:

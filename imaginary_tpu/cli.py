@@ -432,6 +432,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pin every host-executable plan to the host SIMD "
                         "interpreter (measurement override; device-only "
                         "plans still ride the chip)")
+    p.add_argument("--arena-mb", type=float,
+                   default=_env_float("IMAGINARY_TPU_ARENA_MB", 0.0),
+                   help="per-thread native codec scratch-arena budget in "
+                        "MB: worker threads reuse decode/resize/encode "
+                        "scratch at its high-water size, an over-budget "
+                        "thread drops its arena after the call (0 = "
+                        "unlimited)")
+    p.add_argument("--host-dct-spill",
+                   default=_env_str("IMAGINARY_TPU_HOST_DCT_SPILL", "on"),
+                   choices=["on", "off"],
+                   help="DCT-domain shrink-on-load for spilled baseline-"
+                        "JPEG work: eligible dct-transport plans that land "
+                        "on the host fold + IDCT at the shrunk size "
+                        "instead of full decode + resample (only reachable "
+                        "under --transport-dct; off restores the full-"
+                        "decode spill path)")
     # hedged failover dispatch (engine/executor.py): default OFF so the
     # device path stays byte-identical to the unhedged build
     p.add_argument("--hedge-threshold-ms", type=float,
@@ -671,6 +687,8 @@ def options_from_args(args) -> ServerOptions:
         lane_inflight=max(1, args.lane_inflight),
         host_spill={"auto": None, "on": True, "off": False}[args.host_spill],
         force_host=args.force_host,
+        arena_mb=max(0.0, args.arena_mb),
+        host_dct_spill=args.host_dct_spill != "off",
         hedge_threshold_ms=max(0.0, args.hedge_threshold_ms),
         hedge_budget=min(1.0, max(0.0, args.hedge_budget)),
         prewarm=args.prewarm,

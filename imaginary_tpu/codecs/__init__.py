@@ -384,6 +384,10 @@ def _bomb_gate(buf: bytes, t: ImageType) -> None:
     cap = _DECODE_PIXEL_CAP.get()
     if cap <= 0.0:
         return
+    _cap_check(buf, t, cap)
+
+
+def _cap_check(buf: bytes, t: ImageType, cap: float) -> None:
     try:
         b = _backend()
         fast = getattr(b, "probe_fast", None)
@@ -399,6 +403,21 @@ def _bomb_gate(buf: bytes, t: ImageType) -> None:
         raise CodecError(
             f"image dimensions {m.width}x{m.height} exceed the "
             f"{cap:g} megapixel decode limit", 413)
+
+
+def bomb_gate_prefix(buf) -> None:
+    """Ingress-time arm of the bomb gate: run the declared-dimension check
+    over a streamed header PREFIX so an over-cap upload is refused while
+    its body is still on the wire (web/sources.py calls this as soon as
+    the first ~64 KB land). Accepts any bytes-like; no-ops when the cap is
+    disarmed or the prefix doesn't parse yet — the decode-time gate stays
+    the authority, and keeps the codec.bomb failpoint to itself so
+    injected faults fire exactly once per request."""
+    cap = _DECODE_PIXEL_CAP.get()
+    if cap <= 0.0:
+        return
+    b = bytes(buf)
+    _cap_check(b, determine_image_type(b), cap)
 
 
 def decode(buf: bytes, shrink: int = 1) -> DecodedImage:

@@ -744,6 +744,29 @@ def _fold_weights(k: int) -> np.ndarray:
     return w
 
 
+_FOLD_MATRICES: dict = {}
+
+
+def _fold_matrix(k: int) -> np.ndarray:
+    """The 8 x k frequency-alias matrix F with F[u, r] = the signed weight
+    frequency u contributes to folded frequency r (see _fold_axis)."""
+    F = _FOLD_MATRICES.get(k)
+    if F is None:
+        w = _fold_weights(k)
+        F = np.zeros((8, k), dtype=np.float64)
+        for u in range(8):
+            q, r = divmod(u, 2 * k)
+            sign = -1 if q & 1 else 1
+            if r > k:
+                r = 2 * k - r
+                sign = -sign
+            if r == k:
+                continue
+            F[u, r] += sign * w[u]
+        _FOLD_MATRICES[k] = F
+    return F
+
+
 def _fold_axis(arr: np.ndarray, axis: int, k: int) -> np.ndarray:
     """Alias the 8 basis frequencies along `axis` onto the k-point basis.
 
@@ -756,35 +779,48 @@ def _fold_axis(arr: np.ndarray, axis: int, k: int) -> np.ndarray:
     """
     if k == 8:
         return arr.astype(np.float64)
-    w = _fold_weights(k)
-    shape = list(arr.shape)
-    shape[axis] = k
-    out = np.zeros(shape, dtype=np.float64)
-    src = [slice(None)] * arr.ndim
-    dst = [slice(None)] * arr.ndim
-    for u in range(8):
-        q, r = divmod(u, 2 * k)
-        sign = -1 if q & 1 else 1
-        if r > k:
-            r = 2 * k - r
-            sign = -sign
-        if r == k:
-            continue
-        src[axis] = u
-        dst[axis] = r
-        out[tuple(dst)] += (sign * w[u]) * arr[tuple(src)]
-    return out
+    out = np.tensordot(arr, _fold_matrix(k), axes=([axis], [0]))
+    return np.moveaxis(out, -1, axis)
+
+
+_FOLD_KERNELS: dict = {}
+
+
+def _fold_kernel(q: np.ndarray, kv: int, kh: int) -> np.ndarray:
+    """The fused dequantize+fold kernel: a (64, kv*kh) float32 matrix
+    W[(u,v), (r,s)] = q[u,v] * Fv[u,r] * Fh[v,s], so one GEMM over the
+    flattened block grid replaces dequantization and both axis folds.
+    Keyed by the quant table bytes — JPEG streams reuse a handful."""
+    key = (q.tobytes(), kv, kh)
+    W = _FOLD_KERNELS.get(key)
+    if W is None:
+        fv = np.eye(8) if kv == 8 else _fold_matrix(kv)
+        fh = np.eye(8) if kh == 8 else _fold_matrix(kh)
+        W = np.einsum("uv,ur,vs->uvrs", q.astype(np.float64), fv, fh)
+        W = np.ascontiguousarray(
+            W.reshape(64, kv * kh).astype(np.float32))
+        _FOLD_KERNELS[key] = W
+    return W
 
 
 def _fold_plane(blocks: np.ndarray, q: np.ndarray, kv: int,
                 kh: int) -> np.ndarray:
-    """Dequantize (exact int math) + fold one block grid to kv x kh per
-    block, tiled out to a [rows*kv, cols*kh] coefficient plane."""
-    deq = blocks.astype(np.int32) * q.astype(np.int32)[None, None]
-    sub = np.rint(_fold_axis(_fold_axis(deq, 2, kv), 3, kh))
-    sub = sub.astype(np.int16)
-    return sub.transpose(0, 2, 1, 3).reshape(
-        blocks.shape[0] * kv, blocks.shape[1] * kh)
+    """Dequantize + fold one block grid to kv x kh per block, tiled out
+    to a [rows*kv, cols*kh] coefficient plane.
+
+    One float32 GEMM against the fused _fold_kernel — the separable
+    tensordot formulation materialized an int32 dequantized copy and a
+    float64 temporary per axis, and was most of decode_packed's time.
+    Products |coeff*q| stay under 2^24 so the float32 dequantization is
+    exact; the fold then rounds once to int16 (worst case one ulp from
+    the float64 path at exact .5 ties, well inside the parity budget).
+    """
+    W = _fold_kernel(q, kv, kh)
+    rows, cols = blocks.shape[:2]
+    flat = blocks.reshape(rows * cols, 64).astype(np.float32)
+    sub = np.rint(flat @ W).astype(np.int16)
+    sub = sub.reshape(rows, cols, kv, kh)
+    return sub.transpose(0, 2, 1, 3).reshape(rows * kv, cols * kh)
 
 
 def pack_dct(c: DctCoefficients, shrink: int) -> np.ndarray:

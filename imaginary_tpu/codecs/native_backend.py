@@ -201,6 +201,36 @@ def encode_yuv420(y: np.ndarray, u: np.ndarray, v: np.ndarray,
         raise CodecError(f"Cannot encode image: {e}", 400) from None
 
 
+def arena_stats():
+    """Scratch-arena counters from whichever native module carries the
+    arena ABI (full codecs ABI 4+, resample-only ABI 2+), or None when
+    neither does — callers treat None as 'feature absent' so a stale
+    prebuilt .so keeps serving without the counters."""
+    for ext in (_ext, _rext):
+        fn = getattr(ext, "arena_stats", None)
+        if fn is not None:
+            try:
+                return fn()
+            except Exception:  # pragma: no cover - defensive
+                return None
+    return None
+
+
+def set_arena_cap(mb: float) -> bool:
+    """Set the per-thread scratch-arena cap in MB (0 = unlimited) on every
+    native module that supports it. True when at least one accepted."""
+    ok = False
+    for ext in (_ext, _rext):
+        fn = getattr(ext, "set_arena_cap", None)
+        if fn is not None:
+            try:
+                fn(float(mb))
+                ok = True
+            except (TypeError, ValueError, OverflowError):
+                continue  # bad value for one module must not block the rest
+    return ok
+
+
 def probe_fast(buf: bytes, t: ImageType) -> ImageMetadata:
     """Dims/orientation-only probe on the request hot path (shrink-on-load
     selection needs nothing else). The C++ header parser runs with the GIL

@@ -43,7 +43,7 @@ from imaginary_tpu import failpoints
 from imaginary_tpu.engine import host_exec
 from imaginary_tpu.engine import lanes as lanes_mod
 from imaginary_tpu.engine.devhealth import DeviceHealthRegistry
-from imaginary_tpu.engine.timing import LANE_TIMES, TIMES, WIRE
+from imaginary_tpu.engine.timing import COPIES, LANE_TIMES, TIMES, WIRE
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
@@ -315,6 +315,7 @@ class ExecutorStats:
         # bench both read this dict)
         snap = TIMES.snapshot()
         wire = WIRE.snapshot()
+        copies = COPIES.snapshot()
         spill_times = snap.get("host_spill")
         form_times = snap.get("batch_form")
         disp_times = snap.get("dispatch_wait")
@@ -375,6 +376,13 @@ class ExecutorStats:
             "wire_bytes": {"h2d": wire["h2d"], "d2h": wire["d2h"]},
             "wire_transfers": {"h2d": wire["h2d_transfers"],
                                "d2h": wire["d2h_transfers"]},
+            # end-to-end byte-touch ledger (engine/timing.COPIES): host
+            # bytes actually COPIED per stage of the request's journey,
+            # with the copy-event counts riding along. Nested like
+            # wire_bytes so /metrics renders labeled families
+            # (imaginary_tpu_bytes_copied_total{stage=}).
+            "copied_bytes": copies["bytes"],
+            "copy_events": copies["copies"],
         }
         if self.lanes_snapshot is not None:
             lanes = self.lanes_snapshot()

@@ -33,6 +33,7 @@ from imaginary_tpu.ops.stages import (
     ExtractSpec,
     FlipSpec,
     FlopSpec,
+    FromDctSpec,
     FromYuv420Spec,
     GraySpec,
     SampleSpec,
@@ -68,14 +69,43 @@ _HOST_SPECS = (
 )
 
 
+# Host-side DCT-domain shrink-on-load for spilled compressed-domain work
+# (--host-dct-spill; wired at assembly like pipeline.set_transport_dct).
+# Off restores the pre-dct spill behavior: dct plans never place on the
+# host and spill falls back to the full-decode path upstream.
+_DCT_SPILL = True
+
+
+def set_dct_spill(on: bool) -> None:
+    global _DCT_SPILL
+    _DCT_SPILL = bool(on)
+
+
+def dct_spill_enabled() -> bool:
+    return _DCT_SPILL
+
+
 def can_execute(plan, for_spill: bool = True) -> bool:
     """True when every stage of the plan has a host interpretation.
 
     With for_spill (the executor's placement check), smartcrop chains are
     excluded: the host and device saliency maps can legitimately pick
     different windows, and a request's crop must not depend on link load.
+
+    Compressed-domain (dct-transport) plans qualify when --host-dct-spill
+    is on and the plan drains through ToYuv420 — _run_dct reconstructs the
+    planes with the same scaled IDCT the device runs. Egress plans
+    (ToDctSpec drain) stay on the device: the host has no quantizer.
     """
-    for st in plan.stages:
+    stages = plan.stages
+    if getattr(plan, "transport", "") == "dct":
+        if not _DCT_SPILL:
+            return False
+        if (not stages or not isinstance(stages[0].spec, FromDctSpec)
+                or not isinstance(stages[-1].spec, ToYuv420Spec)):
+            return False
+        stages = stages[1:-1]
+    for st in stages:
         if not isinstance(st.spec, _HOST_SPECS):
             return False
         if for_spill and isinstance(st.spec, SmartExtractSpec):
@@ -86,6 +116,8 @@ def can_execute(plan, for_spill: bool = True) -> bool:
 def run(arr: np.ndarray, plan):
     """Execute a plan on one HWC uint8 image; returns HWC uint8 (or
     YuvPlanes for packed-transport plans)."""
+    if plan.transport == "dct":
+        return _run_dct(arr, plan)
     if plan.transport == "yuv420":
         return _run_yuv(arr, plan)
     x = arr
@@ -129,6 +161,121 @@ def _run_yuv(arr: np.ndarray, plan):
     for st in inner:
         x = _apply(st.spec, x, st.dyn)
     return _rgb_to_i420(x)
+
+
+@functools.lru_cache(maxsize=8)
+def _np_idct_basis(k: int) -> np.ndarray:
+    """Host port of ops/stages._idct_basis: the scaled k-point IDCT basis
+    (orthonormal cosines times JPEG's sqrt(k/8) reduced-decode energy
+    factor), so a spilled dct plan reconstructs the SAME pixels the device
+    program would up to f32 contraction order."""
+    u = np.arange(k, dtype=np.float64)[:, None]
+    x = np.arange(k, dtype=np.float64)[None, :]
+    beta = np.where(u == 0, np.sqrt(1.0 / k), np.sqrt(2.0 / k))
+    basis = beta * np.cos((2.0 * x + 1.0) * u * np.pi / (2.0 * k))
+    return (basis * np.sqrt(k / 8.0)).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=16)
+def _np_idct_kernel(kv: int, kh: int) -> np.ndarray:
+    """The separable kv x kh IDCT as one fused (kv*kh, kv*kh) float32
+    matrix K[(u,v),(x,z)] = bv[u,x] * bh[v,z], so the blockwise IDCT is a
+    single GEMM over the flattened block grid."""
+    bv = _np_idct_basis(kv).astype(np.float64)
+    bh = _np_idct_basis(kh).astype(np.float64)
+    K = np.einsum("ux,vz->uvxz", bv, bh).reshape(kv * kh, kv * kh)
+    return np.ascontiguousarray(K.astype(np.float32))
+
+
+def _idct_plane(plane: np.ndarray, kv: int, kh: int) -> np.ndarray:
+    """Blockwise kv x kh scaled IDCT of one folded-coefficient plane
+    (+128 level restore), same contraction as FromDctSpec.apply up to
+    f32 contraction order — one GEMM against the fused kernel."""
+    ph, pw = plane.shape
+    rows, cols = ph // kv, pw // kh
+    blk = plane.reshape(rows, kv, cols, kh).transpose(0, 2, 1, 3)
+    flat = blk.reshape(rows * cols, kv * kh).astype(np.float32)
+    out = flat @ _np_idct_kernel(kv, kh)
+    out = out.reshape(rows, cols, kv, kh).transpose(0, 2, 1, 3)
+    return out.reshape(ph, pw) + np.float32(128.0)
+
+
+def _halve(c: np.ndarray) -> np.ndarray:
+    """2x2 box average with edge replication on odd trailing dims — the
+    chroma downsample ToYuv420Spec would run at the drain. Four strided
+    adds, not a reshape+mean reduction (the strided reduce was ~1 ms per
+    chroma plane at 1080p)."""
+    h, w = c.shape
+    if h % 2 or w % 2:
+        c = np.pad(c, ((0, h % 2), (0, w % 2)), mode="edge")
+    q = np.float32(0.25)
+    return (c[0::2, 0::2] + c[1::2, 0::2] + c[0::2, 1::2] + c[1::2, 1::2]) * q
+
+
+def _halve_v(c: np.ndarray) -> np.ndarray:
+    """Vertical 2x box average (4:2:2 chroma is already half-width)."""
+    if c.shape[0] % 2:
+        c = np.pad(c, ((0, 1), (0, 0)), mode="edge")
+    return (c[0::2, :] + c[1::2, :]) * np.float32(0.5)
+
+
+def _run_dct(arr: np.ndarray, plan):
+    """Spill execution for compressed-domain (dct-transport) plans:
+    DCT-domain shrink-on-load, entirely on the host.
+
+    The packed buffer already carries frequency-FOLDED coefficients
+    (codecs/jpeg_dct.pack_dct), so for shrink > 1 the k-point scaled IDCT
+    lands every plane directly at the shrunk size — the host never
+    materializes full-resolution pixels, which is the whole ns/byte win
+    over decode-then-resample. Chroma normalizes to 4:2:0 geometry right
+    after the IDCT (the drain is ToYuv420 anyway), then the inner stages
+    run planewise exactly like the yuv420 spill path.
+    """
+    from imaginary_tpu.codecs import YuvPlanes
+
+    spec = plan.stages[0].spec
+    hb, wb, k, layout = spec.hb, spec.wb, spec.k, spec.layout
+    h, w = plan.in_h, plan.in_w
+    x = np.asarray(arr)
+    ch, cw = (h + 1) // 2, (w + 1) // 2
+    if layout == "gray":
+        y = _idct_plane(x[:, :, 0], k, k)[:h, :w]
+        u = np.full((ch, cw), 128.0, dtype=np.float32)
+        v = np.full((ch, cw), 128.0, dtype=np.float32)
+    elif layout == "444":
+        y = _idct_plane(x[:, :, 0], k, k)[:h, :w]
+        u = _halve(_idct_plane(x[:, :, 1], k, k)[:h, :w])
+        v = _halve(_idct_plane(x[:, :, 2], k, k)[:h, :w])
+    elif layout == "422":
+        if k == 8:
+            y = _idct_plane(x[:hb, :, 0], 8, 8)[:h, :w]
+            u = _halve_v(_idct_plane(x[hb:, : wb // 2, 0], 8, 8)[:h, :cw])
+            v = _halve_v(_idct_plane(x[hb:, wb // 2 :, 0], 8, 8)[:h, :cw])
+        else:
+            y = _idct_plane(x[:, :, 0], k, k)[:h, :w]
+            u = _halve(_idct_plane(x[:, :, 1], k, 2 * k)[:h, :w])
+            v = _halve(_idct_plane(x[:, :, 2], k, 2 * k)[:h, :w])
+    else:  # 420
+        if k == 8:
+            y = _idct_plane(x[:hb, :, 0], 8, 8)[:h, :w]
+            u = _idct_plane(x[hb:, : wb // 2, 0], 8, 8)[:ch, :cw]
+            v = _idct_plane(x[hb:, wb // 2 :, 0], 8, 8)[:ch, :cw]
+        else:
+            y = _idct_plane(x[:, :, 0], k, k)[:h, :w]
+            u = _halve(_idct_plane(x[:, :, 1], 2 * k, 2 * k)[:h, :w])
+            v = _halve(_idct_plane(x[:, :, 2], 2 * k, 2 * k)[:h, :w])
+    planes = YuvPlanes(y=_round_u8(y[:, :, None])[:, :, 0],
+                       u=_round_u8(u[:, :, None])[:, :, 0],
+                       v=_round_u8(v[:, :, None])[:, :, 0])
+    inner = plan.stages[1:-1]
+    _PLANE_SPECS = (SampleSpec, ExtractSpec, ShrinkBucketSpec, FlipSpec,
+                    FlopSpec, TransposeSpec, BlurSpec)
+    if all(isinstance(st.spec, _PLANE_SPECS) for st in inner):
+        return _planewise(planes, inner)
+    rgb = _i420_to_rgb(planes)
+    for st in inner:
+        rgb = _apply(st.spec, rgb, st.dyn)
+    return _rgb_to_i420(rgb)
 
 
 def _planewise(planes, inner):

@@ -163,6 +163,65 @@ class WireLedger:
 
 WIRE = WireLedger()
 
+# Canonical byte-touch stages, in request order. The ledger accepts any
+# label (future stages must not require a ledger edit), but these are the
+# ones the host path books today; /metrics emits whatever shows up.
+COPY_STAGES = (
+    "ingress",    # request body landed in host memory (streamed read)
+    "decode",     # codec output pixels materialized
+    "transform",  # intermediate frame copies (host spill / device staging)
+    "encode",     # encoded body materialized
+    "response",   # extra body copies on the serving edge (target: zero)
+    "cache_hit",  # bytes touched serving a cached body (target: 1x body)
+)
+
+
+class CopyLedger:
+    """Per-stage ledger of host bytes actually COPIED per request's journey
+    (ingress -> decode -> transform -> encode -> response), the
+    generalization of the shm tier's `bytes_copied` counter to the whole
+    host path.
+
+    "Bytes touched per byte served" is the metric the reference's libvips
+    core wins on (one C pipeline, no per-hop body materialization); this
+    ledger makes it first-class and gateable: every site that materializes
+    a body or frame books here, so a future "convenience" bytes() slice
+    shows up as a counter regression in bench_stages.py rather than a
+    profiler session. Monotonic totals (exported as
+    imaginary_tpu_bytes_copied_total{stage=}); copy-event counts ride
+    along so copies-per-request stays derivable. Process-wide like WIRE —
+    host memory bandwidth is a per-host resource.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes: dict = {}
+        self._copies: dict = {}
+
+    def add(self, stage: str, nbytes: int, copies: int = 1) -> None:
+        with self._lock:
+            self._bytes[stage] = self._bytes.get(stage, 0) + int(nbytes)
+            self._copies[stage] = self._copies.get(stage, 0) + int(copies)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": dict(self._bytes),
+                "copies": dict(self._copies),
+            }
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(self._bytes.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._bytes = {}
+            self._copies = {}
+
+
+COPIES = CopyLedger()
+
 
 class LaneStageTimes:
     """Per-lane split of the executor stages (multi-chip lanes).

@@ -37,6 +37,7 @@
 #include <Python.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdarg>
 #include <cstdint>
@@ -56,6 +57,81 @@
 #endif  // !ITPU_RESAMPLE_ONLY
 
 namespace {
+
+// ------------------------------------------------ codec scratch arena -------
+//
+// Thread-local get-or-grow scratch for the decode/resize/encode hot paths.
+// Each worker thread serves one image at a time, so one arena per thread
+// with one named slot per purpose removes every transient allocation from
+// the steady state: after the first few requests a thread's buffers sit at
+// their high-water size and later calls just reuse them. Counters are
+// process-wide (relaxed atomics — they are monotone telemetry, not
+// synchronization); the cap is enforced per thread, checked after each
+// top-level call: an over-cap arena drops ALL capacity (an eviction) and
+// the next call rebuilds only what it actually touches. Cap 0 = unlimited.
+
+std::atomic<uint64_t> g_arena_reuses{0};
+std::atomic<uint64_t> g_arena_misses{0};
+std::atomic<uint64_t> g_arena_evictions{0};
+std::atomic<uint64_t> g_arena_bytes{0};  // live capacity, summed over threads
+std::atomic<uint64_t> g_arena_cap{0};    // per-thread byte budget, 0 = off
+
+struct CodecArena {
+  // f32 resampler scratch: padded intermediate row, pair-expanded and
+  // transposed horizontal weights
+  std::vector<float> mid, wpair, wT;
+  // u8 scratch: RGBA expand, generic per-channel planes, libjpeg raw-mode
+  // staging planes (shared by decode and encode — they never interleave
+  // within one call)
+  std::vector<uint8_t> rgba, plane, oplane, ystage, ustage, vstage;
+
+  size_t footprint() const {
+    return (mid.capacity() + wpair.capacity() + wT.capacity()) * sizeof(float)
+         + rgba.capacity() + plane.capacity() + oplane.capacity()
+         + ystage.capacity() + ustage.capacity() + vstage.capacity();
+  }
+  ~CodecArena() {
+    g_arena_bytes.fetch_sub(footprint(), std::memory_order_relaxed);
+  }
+};
+
+thread_local CodecArena t_arena;
+
+// Size a slot for this call. Capacity (not size) decides reuse vs miss:
+// a shrinking request that fits the existing allocation is a reuse.
+// resize() value-initializes GROWTH only — callers that depend on zeroed
+// regions (the resampler's pad margins) clear those explicitly.
+template <typename T>
+std::vector<T>& arena_slot(std::vector<T>& slot, size_t n) {
+  const size_t before = slot.capacity() * sizeof(T);
+  if (before >= n * sizeof(T))
+    g_arena_reuses.fetch_add(1, std::memory_order_relaxed);
+  else
+    g_arena_misses.fetch_add(1, std::memory_order_relaxed);
+  slot.resize(n);
+  const size_t after = slot.capacity() * sizeof(T);
+  if (after > before)
+    g_arena_bytes.fetch_add(after - before, std::memory_order_relaxed);
+  return slot;
+}
+
+void arena_trim() {
+  const uint64_t cap = g_arena_cap.load(std::memory_order_relaxed);
+  if (cap == 0) return;
+  const size_t fp = t_arena.footprint();
+  if ((uint64_t)fp <= cap) return;
+  std::vector<float>().swap(t_arena.mid);
+  std::vector<float>().swap(t_arena.wpair);
+  std::vector<float>().swap(t_arena.wT);
+  std::vector<uint8_t>().swap(t_arena.rgba);
+  std::vector<uint8_t>().swap(t_arena.plane);
+  std::vector<uint8_t>().swap(t_arena.oplane);
+  std::vector<uint8_t>().swap(t_arena.ystage);
+  std::vector<uint8_t>().swap(t_arena.ustage);
+  std::vector<uint8_t>().swap(t_arena.vstage);
+  g_arena_bytes.fetch_sub(fp, std::memory_order_relaxed);
+  g_arena_evictions.fetch_add(1, std::memory_order_relaxed);
+}
 
 // ---------------------------------------------- separable resampler ---------
 //
@@ -204,7 +280,13 @@ void resize_separable_impl(const uint8_t* src, int h, int w, int dh, int dw,
                            uint8_t* dst) {
   const size_t row_elems = (size_t)w * C;
   const int pad = th.ntaps;  // window overhang at either edge
-  std::vector<float> mid_row(((size_t)w + 2 * pad) * C, 0.0f);
+  std::vector<float>& mid_row =
+      arena_slot(t_arena.mid, ((size_t)w + 2 * pad) * C);
+  // the pad margins must read as zero (out-of-range taps carry zero
+  // weight); the reused buffer may hold a previous call's values
+  std::memset(mid_row.data(), 0, (size_t)pad * C * sizeof(float));
+  std::memset(mid_row.data() + ((size_t)pad + w) * C, 0,
+              (size_t)pad * C * sizeof(float));
   for (int y = 0; y < dh; y++) {
     // vertical: blend source rows for this output row only (no dh*w*C
     // intermediate — better cache locality and a fraction of the memory).
@@ -263,9 +345,8 @@ void resize_separable_avx2(const uint8_t* src, int h, int w, int c, int dh,
                            int dw, const TapTable& tv, const TapTable& th,
                            uint8_t* dst) {
   const uint8_t* s4 = src;
-  std::vector<uint8_t> rgba;
   if (c == 3) {  // one up-front 3->4 expand keeps every later row load aligned to pixels
-    rgba.resize((size_t)h * w * 4);
+    std::vector<uint8_t>& rgba = arena_slot(t_arena.rgba, (size_t)h * w * 4);
     const size_t n = (size_t)h * w;
     size_t i = 0;
     // pshufb 4 pixels per step (12 source bytes -> 16, alpha lanes zeroed
@@ -290,12 +371,16 @@ void resize_separable_avx2(const uint8_t* src, int h, int w, int c, int dh,
   }
   const int pad = th.ntaps;
   const size_t row4 = (size_t)w * 4;
-  std::vector<float> mid(((size_t)w + 2 * pad) * 4, 0.0f);
+  std::vector<float>& mid = arena_slot(t_arena.mid, ((size_t)w + 2 * pad) * 4);
+  std::memset(mid.data(), 0, (size_t)pad * 4 * sizeof(float));
+  std::memset(mid.data() + ((size_t)pad + w) * 4, 0,
+              (size_t)pad * 4 * sizeof(float));
   float* mrow = mid.data() + (size_t)pad * 4;
   // pair-expanded horizontal weights: [pair][tap][w0 w0 w0 w0 w1 w1 w1 w1]
   // — one unaligned 256-bit load per tap, no in-loop shuffles
   const int npairs = dw / 2;
-  std::vector<float> wpair((size_t)npairs * th.ntaps * 8);
+  std::vector<float>& wpair =
+      arena_slot(t_arena.wpair, (size_t)npairs * th.ntaps * 8);
   for (int p = 0; p < npairs; p++) {
     for (int j = 0; j < th.ntaps; j++) {
       const float w0 = th.wts[(size_t)(2 * p) * th.ntaps + j];
@@ -405,9 +490,11 @@ void resize_separable_avx2_1(const uint8_t* src, int h, int w, int dh, int dw,
                              const TapTable& tv, const TapTable& th,
                              uint8_t* dst) {
   const int pad = th.ntaps;
-  std::vector<float> mid((size_t)w + 2 * pad, 0.0f);
+  std::vector<float>& mid = arena_slot(t_arena.mid, (size_t)w + 2 * pad);
+  std::memset(mid.data(), 0, (size_t)pad * sizeof(float));
+  std::memset(mid.data() + (size_t)pad + w, 0, (size_t)pad * sizeof(float));
   float* mrow = mid.data() + pad;
-  std::vector<float> wT((size_t)th.ntaps * dw);
+  std::vector<float>& wT = arena_slot(t_arena.wT, (size_t)th.ntaps * dw);
   for (int x = 0; x < dw; x++)
     for (int j = 0; j < th.ntaps; j++)
       wT[(size_t)j * dw + x] = th.wts[(size_t)x * th.ntaps + j];
@@ -488,7 +575,8 @@ void resize_separable_u8(const uint8_t* src, int h, int w, int c, int dh,
   if (c == 3) return resize_separable_impl<3>(src, h, w, dh, dw, tv, th, dst);
   if (c == 4) return resize_separable_impl<4>(src, h, w, dh, dw, tv, th, dst);
   // arbitrary channel count: plane-at-a-time through the 1-channel kernel
-  std::vector<uint8_t> plane((size_t)h * w), oplane((size_t)dh * dw);
+  std::vector<uint8_t>& plane = arena_slot(t_arena.plane, (size_t)h * w);
+  std::vector<uint8_t>& oplane = arena_slot(t_arena.oplane, (size_t)dh * dw);
   for (int ch = 0; ch < c; ch++) {
     for (size_t i = 0, n = (size_t)h * w; i < n; i++)
       plane[i] = src[i * c + ch];
@@ -521,9 +609,29 @@ PyObject* py_resize_separable(PyObject*, PyObject* args) {
   std::string kind(kernel);
   Py_BEGIN_ALLOW_THREADS
   resize_separable_u8(src, h, w, c, dh, dw, kind, dst);
+  arena_trim();
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&view);
   return out;
+}
+
+PyObject* py_arena_stats(PyObject*, PyObject*) {
+  return Py_BuildValue(
+      "{s:K,s:K,s:K,s:K,s:K}",
+      "reuses", (unsigned long long)g_arena_reuses.load(std::memory_order_relaxed),
+      "misses", (unsigned long long)g_arena_misses.load(std::memory_order_relaxed),
+      "evictions", (unsigned long long)g_arena_evictions.load(std::memory_order_relaxed),
+      "bytes", (unsigned long long)g_arena_bytes.load(std::memory_order_relaxed),
+      "cap_bytes", (unsigned long long)g_arena_cap.load(std::memory_order_relaxed));
+}
+
+PyObject* py_set_arena_cap(PyObject*, PyObject* args) {
+  double mb;
+  if (!PyArg_ParseTuple(args, "d", &mb)) return nullptr;
+  if (mb < 0.0) mb = 0.0;
+  g_arena_cap.store((uint64_t)(mb * 1024.0 * 1024.0),
+                    std::memory_order_relaxed);
+  Py_RETURN_NONE;
 }
 
 #ifndef ITPU_RESAMPLE_ONLY
@@ -726,9 +834,9 @@ bool jpeg_decode_yuv420(const uint8_t* buf, size_t len, int scale_denom,
   // memcpy into the packed layout. The extra copy is ~0.1 ms per image.
   const size_t lstride = ((size_t)lw + 63) / 64 * 64;
   const size_t cstride = ((size_t)cw0 + 63) / 64 * 64;
-  std::vector<uint8_t> Y(lstride * (lh + 32));
-  std::vector<uint8_t> U(cstride * (ch0 + 32));
-  std::vector<uint8_t> V(cstride * (ch0 + 32));
+  std::vector<uint8_t>& Y = arena_slot(t_arena.ystage, lstride * (lh + 32));
+  std::vector<uint8_t>& U = arena_slot(t_arena.ustage, cstride * (ch0 + 32));
+  std::vector<uint8_t>& V = arena_slot(t_arena.vstage, cstride * (ch0 + 32));
   const int rg0 = cinfo.comp_info[0].v_samp_factor * cinfo.comp_info[0].DCT_scaled_size;
   const int rg1 = cinfo.comp_info[1].v_samp_factor * cinfo.comp_info[1].DCT_scaled_size;
   const int mcu_rows = cinfo.max_v_samp_factor * cinfo.min_DCT_scaled_size;
@@ -800,7 +908,9 @@ bool jpeg_encode_yuv420(const uint8_t* y, const uint8_t* u, const uint8_t* v,
   // iMCU-padded planes with edge replication (encoder reads 16-row groups)
   const int pw = (w + 15) / 16 * 16, ph = (h + 15) / 16 * 16;
   const int pcw = pw / 2, pch = ph / 2;
-  std::vector<uint8_t> Y((size_t)pw * ph), U((size_t)pcw * pch), V((size_t)pcw * pch);
+  std::vector<uint8_t>& Y = arena_slot(t_arena.ystage, (size_t)pw * ph);
+  std::vector<uint8_t>& U = arena_slot(t_arena.ustage, (size_t)pcw * pch);
+  std::vector<uint8_t>& V = arena_slot(t_arena.vstage, (size_t)pcw * pch);
   for (int r = 0; r < ph; r++) {
     const uint8_t* src = y + (size_t)w * ((r < h) ? r : h - 1);
     uint8_t* dst = Y.data() + (size_t)pw * r;
@@ -2078,6 +2188,7 @@ PyObject* py_decode_yuv420(PyObject*, PyObject* args) {
   Py_BEGIN_ALLOW_THREADS
   ok = jpeg_decode_yuv420(buf, len, scale_denom, hb, wb, &packed, &h, &w, &err);
   if (ok) orientation = exif_orientation(buf, len);
+  arena_trim();
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&view);
   if (!ok) {
@@ -2114,6 +2225,7 @@ PyObject* py_encode_yuv420(PyObject*, PyObject* args) {
                           static_cast<const uint8_t*>(uv.buf),
                           static_cast<const uint8_t*>(vv.buf), h, w, quality,
                           progressive != 0, &out, &err);
+  arena_trim();
   Py_END_ALLOW_THREADS
   PyBuffer_Release(&yv);
   PyBuffer_Release(&uv);
@@ -2139,6 +2251,10 @@ PyMethodDef methods[] = {
      "encode_yuv420(y, u, v, h, w, quality, progressive) -> bytes"},
     {"resize_separable", py_resize_separable, METH_VARARGS,
      "resize_separable(buf, h, w, c, dst_h, dst_w, kernel) -> bytes"},
+    {"arena_stats", py_arena_stats, METH_NOARGS,
+     "arena_stats() -> {reuses, misses, evictions, bytes, cap_bytes}"},
+    {"set_arena_cap", py_set_arena_cap, METH_VARARGS,
+     "set_arena_cap(mb) — per-thread scratch-arena byte budget, 0 = unlimited"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -2152,6 +2268,10 @@ PyModuleDef moduledef = {
 PyMethodDef resample_methods[] = {
     {"resize_separable", py_resize_separable, METH_VARARGS,
      "resize_separable(buf, h, w, c, dst_h, dst_w, kernel) -> bytes"},
+    {"arena_stats", py_arena_stats, METH_NOARGS,
+     "arena_stats() -> {reuses, misses, evictions, bytes, cap_bytes}"},
+    {"set_arena_cap", py_set_arena_cap, METH_VARARGS,
+     "set_arena_cap(mb) — per-thread scratch-arena byte budget, 0 = unlimited"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -2173,8 +2293,9 @@ PyMODINIT_FUNC PyInit__imaginary_codecs(void) {
   TIFFSetErrorHandler(tiff_quiet);
   TIFFSetWarningHandler(tiff_quiet);
   PyObject* m = PyModule_Create(&moduledef);
-  // 3: +gif/tiff codecs, +full PNG (interlace/palette/speed)
-  if (m) PyModule_AddIntConstant(m, "ABI", 3);
+  // 4: +scratch arena (arena_stats/set_arena_cap); 3: +gif/tiff codecs,
+  // +full PNG (interlace/palette/speed)
+  if (m) PyModule_AddIntConstant(m, "ABI", 4);
   // what THIS build carries: the binding routes absent formats to cv2/PIL
 #ifndef ITPU_NO_WEBP
   if (m) PyModule_AddStringConstant(m, "FORMATS", "jpeg,png,webp,gif,tiff");
@@ -2188,7 +2309,7 @@ PyMODINIT_FUNC PyInit__imaginary_codecs(void) {
 
 PyMODINIT_FUNC PyInit__imaginary_resample(void) {
   PyObject* m = PyModule_Create(&resample_moduledef);
-  if (m) PyModule_AddIntConstant(m, "ABI", 1);
+  if (m) PyModule_AddIntConstant(m, "ABI", 2);  // 2: +scratch arena
   return m;
 }
 

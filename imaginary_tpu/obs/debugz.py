@@ -88,6 +88,22 @@ def debug_payload(service) -> dict:
         # (deadline_budget_ms / deadline_remaining_ms / deadline_stages).
         "failpoints": failpoints.snapshot(),
     }
+    # end-to-end byte-touch ledger (engine/timing.COPIES): service-free
+    # because the ledger is process-wide — a debug dump of a bare worker
+    # still shows what the host path copied
+    from imaginary_tpu.engine.timing import COPIES
+
+    payload["copies"] = COPIES.snapshot()
+    # native codec scratch-arena counters; None (absent) when the built
+    # extension predates the arena ABI
+    try:
+        from imaginary_tpu.codecs import native_backend
+
+        arena = native_backend.arena_stats()
+        if arena is not None:
+            payload["arena"] = arena
+    except Exception:  # itpu: allow[ITPU004] a debug payload never takes down /debugz
+        pass
     if service is not None:
         payload["executor"] = service.executor.debug_snapshot()
         payload["executor_counters"] = service.executor.stats.to_dict()

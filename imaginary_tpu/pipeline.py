@@ -24,7 +24,7 @@ import numpy as np
 from imaginary_tpu import codecs
 from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu import failpoints
-from imaginary_tpu.engine.timing import TIMES
+from imaginary_tpu.engine.timing import COPIES, TIMES
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.codecs import EncodeOptions, YuvPlanes
 from imaginary_tpu.errors import ImageError, new_error
@@ -109,6 +109,13 @@ WatermarkFetcher = Callable[[str], np.ndarray]
 class ProcessedImage:
     body: bytes
     mime: str
+    # Output geometry stamped from the plan (the single source of
+    # geometry truth): the result-cache meta then carries it, so a
+    # ?returnSize=1 cache hit serves its headers without re-probing —
+    # or copying — the stored body. 0 = unknown (legacy/shm entries);
+    # the serving edge probes a bounded header prefix for those.
+    width: int = 0
+    height: int = 0
 
 
 def _encode_type(o: ImageOptions, source: ImageType) -> ImageType:
@@ -156,6 +163,7 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
             try:
                 body = codecs.jpeg_dct.encode_quantized(arr)
                 TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
+                COPIES.add("encode", len(body))
                 return ProcessedImage(body=body,
                                       mime=get_image_mime_type(target))
             except ImageError:
@@ -167,6 +175,7 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
             try:
                 body = codecs.encode_yuv(arr, opts)
                 TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
+                COPIES.add("encode", len(body))
                 return ProcessedImage(body=body, mime=get_image_mime_type(target))
             except ImageError:
                 pass  # fall through to the RGB encoder
@@ -182,6 +191,7 @@ def _encode(arr, o: ImageOptions, target: ImageType) -> ProcessedImage:
         else:
             raise
     TIMES.record("encode", (time.monotonic() - t0) * 1000.0)
+    COPIES.add("encode", len(body))
     return ProcessedImage(body=body, mime=get_image_mime_type(actual))
 
 
@@ -193,6 +203,10 @@ def _carry_metadata(src_buf: bytes, strip: bool, out: ProcessedImage,
     metadata). Orientation resets to 1 when the chain already applied the
     EXIF rotation, and PixelX/YDimension re-sync to the output geometry —
     both exactly as libvips does on save."""
+    # every op path funnels through here with the plan's output geometry:
+    # stamp it so the serving edge never re-probes the body for dims
+    out.width = out_w
+    out.height = out_h
     if strip or out.mime != "image/jpeg":
         return out
     segs = codecs.jpeg_metadata_segments(src_buf)
@@ -208,9 +222,11 @@ def _carry_metadata(src_buf: bytes, strip: bool, out: ProcessedImage,
         if s[4:10] == b"Exif\x00\x00" else s
         for s in segs
     ]
-    return ProcessedImage(
-        body=codecs.insert_jpeg_segments(out.body, segs), mime=out.mime
-    )
+    body = codecs.insert_jpeg_segments(out.body, segs)
+    # metadata carry re-materializes the body (splice copy): ledger it
+    COPIES.add("encode", len(body))
+    return ProcessedImage(body=body, mime=out.mime,
+                          width=out_w, height=out_h)
 
 
 def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
@@ -229,7 +245,14 @@ def _run_stages(arr: np.ndarray, plan: ImagePlan, runner=None) -> np.ndarray:
         # wait + device H2D/compute/drain, OR the host-spill path (whose
         # host_gate/host_spill sub-spans attribute via the timing hook)
         with obs_trace.span("execute"):
-            return (runner or chain_mod.run_single)(arr, plan)
+            out = (runner or chain_mod.run_single)(arr, plan)
+            # the transform stage's one materialized frame (device drain
+            # or host-interpreter output); structured results (YuvPlanes/
+            # QuantizedBlocks) book at their encode instead
+            nb = getattr(out, "nbytes", 0)
+            if nb:
+                COPIES.add("transform", int(nb))
+            return out
     except ImageError:
         raise
     except Exception as e:  # XLA/compile/runtime errors
@@ -361,6 +384,7 @@ def _decode_cached(buf, shrink, frame_cache=None, digest=None):
             return d
     failpoints.hit("codec.decode")
     d = codecs.decode(buf, shrink)
+    COPIES.add("decode", d.array.nbytes)
     if key is not None:
         d.array.setflags(write=False)
         frame_cache.put(key, d, d.array.nbytes)
@@ -390,6 +414,7 @@ def _decode_yuv_packed(buf, shrink, sh, sw, frame_cache=None, digest=None):
     if (h, w) != (sh, sw):
         return None
     TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    COPIES.add("decode", packed.nbytes)
     if key is not None:
         packed.setflags(write=False)
         frame_cache.put(key, (packed, hb, wb), packed.nbytes)
@@ -420,6 +445,7 @@ def _decode_dct_packed(buf, shrink, frame_cache=None, digest=None):
         return None
     packed, h2, w2, layout = got
     TIMES.record("decode", (time.monotonic() - t0) * 1000.0)
+    COPIES.add("decode", packed.nbytes)
     fkey = (digest, shrink, "dct") if digest is not None else None
     if key is not None:
         packed.setflags(write=False)

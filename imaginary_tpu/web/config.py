@@ -196,6 +196,17 @@ class ServerOptions:
     # Pin every host-executable plan to the host interpreter (measurement
     # override for bench_latency's host-path rows; see ExecutorConfig).
     force_host: bool = False
+    # Per-thread native codec scratch-arena byte budget in MB
+    # (native/codecs.cpp CodecArena): worker threads reuse decode/resize/
+    # encode scratch at its high-water size; an over-budget thread drops
+    # its arena after the call (counted as an eviction). 0 = unlimited.
+    arena_mb: float = 0.0
+    # Host-side DCT-domain shrink-on-load for SPILLED baseline-JPEG work
+    # (engine/host_exec.py _run_dct): eligible dct-transport plans that
+    # land on the host fold + IDCT at the shrunk size instead of full
+    # decode + resample. Only reachable under --transport-dct; default on
+    # (off restores the full-decode spill path byte-for-byte).
+    host_dct_spill: bool = True
     # Hedged failover dispatch (ExecutorConfig.hedge_threshold_ms): after
     # this many ms stuck on the device path, launch a host-path twin and
     # take the first success. 0 = OFF (the parity default — the submit

@@ -28,6 +28,7 @@ from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu import failpoints
 from imaginary_tpu.engine import Executor, ExecutorConfig
 from imaginary_tpu.engine import pressure as pressure_mod
+from imaginary_tpu.engine.timing import COPIES
 from imaginary_tpu.errors import (
     ErrEmptyBody,
     ErrNotFound,
@@ -142,6 +143,15 @@ class ImageService:
         from imaginary_tpu.codecs import jpeg_dct as jpeg_dct_mod
 
         jpeg_dct_mod.set_decoder(o.dct_native)
+        # native codec scratch-arena budget + host-side DCT shrink-on-load
+        # for spilled work: both module-level switches, same wiring shape
+        # as the transport toggles above
+        from imaginary_tpu.codecs import native_backend as native_backend_mod
+        from imaginary_tpu.engine import host_exec as host_exec_mod
+
+        if o.arena_mb > 0:
+            native_backend_mod.set_arena_cap(o.arena_mb)
+        host_exec_mod.set_dct_spill(o.host_dct_spill)
         from imaginary_tpu.ops import chain as dev_chain_mod
 
         if o.cache_device_mb > 0:
@@ -331,6 +341,14 @@ class ImageService:
                         503, headers={"Retry-After": _retry_after_s(est_ms)})
             if qos is not None:
                 qos.stats.note_admitted(kidx)
+            if self.pressure is not None and o.max_allowed_pixels > 0:
+                # arm the codec-level bomb cap BEFORE the fetch: the
+                # streaming body source runs the same dimension check on
+                # the header prefix as soon as it lands (web/sources.py),
+                # so an over-cap upload 413s while its body is still on
+                # the wire. _process_and_respond re-arms the same value
+                # for the pool-thread context — idempotent.
+                codecs.set_decode_pixel_cap(o.max_allowed_pixels)
             with obs_trace.span("fetch"):
                 buf = await self._get_source_image(request)
             if not buf:
@@ -480,6 +498,9 @@ class ImageService:
                 if tr is not None:
                     tr.annotate(cache="result_hit")
                 out, placement = hit
+                # the ONE read of the stored body a local hit pays (the
+                # response writes straight from it — no snapshot at all)
+                COPIES.add("cache_hit", len(out.body))
                 return self._build_response(out, placement, vary, etag, o)
             if caches.result.enabled:
                 caches.stats.result_misses += 1
@@ -490,6 +511,10 @@ class ImageService:
             shm_hit = caches.shm_lookup(key)
             if shm_hit is not None:
                 out, placement = shm_hit
+                # the shm tier's defensive mmap snapshot IS the one copy
+                # a fleet hit pays; mirror it into the unified ledger so
+                # both tiers grade on the same copies-per-hit == 1 bar
+                COPIES.add("cache_hit", len(out.body))
                 if caches.result.enabled:
                     # promote: the next local hit skips the IPC copy
                     caches.result.put(key, (out, placement), len(out.body))
@@ -577,6 +602,11 @@ class ImageService:
             caches.shm_store(key, out, placement)
         return self._build_response(out, placement, vary, etag, o)
 
+    # returnSize probes at most this many header bytes when an entry's
+    # meta carries no dims (legacy/shm entries): SOF/IHDR live in the
+    # first KBs, so a multi-MB body is never copied to read its header
+    _PROBE_PREFIX = 64 * 1024
+
     def _build_response(self, out, placement, vary, etag, o) -> web.Response:
         headers = {}
         if placement:
@@ -586,14 +616,22 @@ class ImageService:
         if etag:
             headers["ETag"] = etag
         if o.return_size and out.mime != "application/json":
-            try:
-                # cache hits may carry a memoryview body (zero-copy shm
-                # serving); the header probe needs real bytes
-                m = codecs.probe(bytes(out.body))
-                headers["Image-Width"] = str(m.width)
-                headers["Image-Height"] = str(m.height)
-            except ImageError:
-                pass
+            # dims ride the result-cache meta (pipeline stamps plan
+            # geometry into ProcessedImage), so the hot path re-probes
+            # nothing and copies nothing
+            w = getattr(out, "width", 0)
+            h = getattr(out, "height", 0)
+            if not (w and h):
+                try:
+                    prefix = bytes(memoryview(out.body)[:self._PROBE_PREFIX])
+                    COPIES.add("response", len(prefix))
+                    m = codecs.probe(prefix)
+                    w, h = m.width, m.height
+                except ImageError:
+                    w = h = 0
+            if w and h:
+                headers["Image-Width"] = str(w)
+                headers["Image-Height"] = str(h)
         return web.Response(body=out.body, content_type=out.mime, headers=headers)
 
     async def _prefetch_watermark(self, request, op_name, opts) -> Optional[np.ndarray]:
@@ -728,6 +766,14 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
             from imaginary_tpu.web.ingress import STATS as ingress_stats
 
             stats["ingress"] = ingress_stats.to_dict()
+        # native codec scratch-arena counters: absent when the built
+        # extension predates the arena ABI (the block's presence IS the
+        # armed signal, matching fleet/integrity/slo)
+        from imaginary_tpu.codecs import native_backend
+
+        arena = native_backend.arena_stats()
+        if arena is not None:
+            stats["arena"] = arena
     return stats
 
 

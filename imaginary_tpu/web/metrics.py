@@ -86,6 +86,8 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
     qos_classes: dict = {}
     hedge_outcomes: dict = {}
     wire: dict = {}
+    copies: dict = {}
+    arena: dict = {}
     lanes_list: list = []
     wire_by_device: dict = {}
     device_health: dict = {}
@@ -114,6 +116,11 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                     # per unit, h2d/d2h as labels)
                     wire[k] = v
                     continue
+                if k in ("copied_bytes", "copy_events") and isinstance(v, dict):
+                    # deferred: stage-labeled byte-touch families
+                    # (imaginary_tpu_bytes_copied_total{stage=})
+                    copies[k] = v
+                    continue
                 if k == "lanes" and isinstance(v, list):
                     # deferred: lane-labeled families (per-chip serving
                     # lanes, engine/lanes.py) — only present when
@@ -136,6 +143,11 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
             fleet = value
         elif key == "ingress" and isinstance(value, dict):
             ingress = value
+        elif key == "arena" and isinstance(value, dict):
+            # native codec scratch arena counters (native_backend
+            # .arena_stats()); present only when the native extension
+            # carries the arena ABI
+            arena = value
         elif key == "slo" and isinstance(value, dict):
             slo = value
         elif key == "cache" and isinstance(value, dict):
@@ -215,6 +227,37 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
                f'direction="{escape_label_value(direction)}"',
                mtype="counter",
                help_text="Device-link transfer operations by direction.")
+    for stage, v in sorted(copies.get("copied_bytes", {}).items()):
+        x.emit("imaginary_tpu_bytes_copied_total", v,
+               f'stage="{escape_label_value(stage)}"', mtype="counter",
+               help_text="Host bytes actually copied per stage of the "
+                         "request journey (ingress/decode/transform/"
+                         "encode/response/cache_hit) — the byte-touch "
+                         "ledger.")
+    for stage, v in sorted(copies.get("copy_events", {}).items()):
+        x.emit("imaginary_tpu_copy_events_total", v,
+               f'stage="{escape_label_value(stage)}"', mtype="counter",
+               help_text="Copy events booked per stage (copies-per-"
+                         "request derives as events over requests).")
+    if arena:
+        x.emit("imaginary_tpu_arena_reuses_total", arena.get("reuses", 0),
+               mtype="counter",
+               help_text="Native codec-scratch requests served from the "
+                         "thread-local arena without allocating.")
+        x.emit("imaginary_tpu_arena_misses_total", arena.get("misses", 0),
+               mtype="counter",
+               help_text="Native codec-scratch requests that had to grow "
+                         "an arena slot (cold thread or high-water bump).")
+        x.emit("imaginary_tpu_arena_evictions_total",
+               arena.get("evictions", 0), mtype="counter",
+               help_text="Arena trims forced by the --arena-mb per-thread "
+                         "cap (slots released back to the allocator).")
+        x.emit("imaginary_tpu_arena_bytes", arena.get("bytes", 0),
+               help_text="High-water bytes currently held by codec "
+                         "scratch arenas across threads.")
+        x.emit("imaginary_tpu_arena_cap_bytes", arena.get("cap_bytes", 0),
+               help_text="Configured per-thread arena cap in bytes "
+                         "(0 = unlimited).")
     for direction, per_dev in sorted(wire_by_device.items()):
         for dev, v in sorted(per_dev.items()):
             x.emit("imaginary_tpu_wire_device_bytes_total", v,

@@ -35,8 +35,10 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from imaginary_tpu import codecs
 from imaginary_tpu import deadline as deadline_mod
 from imaginary_tpu import failpoints
+from imaginary_tpu.engine import timing
 from imaginary_tpu.errors import (
     ErrEntityTooLarge,
     ErrInvalidFilePath,
@@ -56,6 +58,39 @@ WATERMARK_MAX_BYTES = 1_000_000  # ref: image.go:352
 RETRY_BACKOFF_BASE_S = 0.1  # exponential base for attempt n: base * 2**n
 RETRY_BACKOFF_CAP_S = 2.0  # one sleep never exceeds this (full jitter below it)
 RETRY_AFTER_CAP_S = 10.0  # an origin demanding a longer pause isn't worth waiting on
+GATE_PREFIX = 1 << 16  # header bytes streamed before the early bomb gate runs
+
+
+async def _stream_body(next_chunk) -> bytearray:
+    """Single-buffer streaming read shared by the body source's two forms.
+
+    One copy total: chunks append into the ONE growable buffer that IS
+    the returned body — the old paths paid a second full-body copy in a
+    terminal bytes(data) (every downstream consumer reads via the buffer
+    protocol, so bytes-ness was never load-bearing). The decode-bomb gate
+    runs as soon as the header prefix lands, so an over-cap image 413s
+    after ~64 KB instead of after the full upload; the byte-size cap
+    still applies during the read for requests that lied about (or
+    omitted) Content-Length. Ingress bytes book into the copy ledger.
+    """
+    data = bytearray()
+    gated = False
+    while True:
+        try:
+            chunk = await next_chunk()
+        except StopAsyncIteration:
+            break
+        if not chunk:
+            break
+        data.extend(chunk)
+        if len(data) > MAX_BODY_SIZE:
+            raise ErrEntityTooLarge
+        if not gated and len(data) >= GATE_PREFIX:
+            # short bodies skip this: the decode-time gate covers them
+            codecs.bomb_gate_prefix(memoryview(data)[:GATE_PREFIX])
+            gated = True
+    timing.COPIES.add("ingress", len(data))
+    return data
 
 
 class BodyImageSource:
@@ -82,24 +117,23 @@ class BodyImageSource:
         reader = await request.multipart()
         async for part in reader:
             if part.name == field:
-                data = bytearray()
-                while True:
-                    chunk = await part.read_chunk(1 << 16)
-                    if not chunk:
-                        break
-                    data.extend(chunk)
-                    if len(data) > MAX_BODY_SIZE:
-                        raise ErrEntityTooLarge
-                return bytes(data)
+                # reject on the part's OWN declared length before the read
+                # loop (the request-level Content-Length includes boundary
+                # overhead, so the part header is the strict bound)
+                declared = part.headers.get("Content-Length", "")
+                if declared.isdigit() and int(declared) > MAX_BODY_SIZE:
+                    raise ErrEntityTooLarge
+                return await _stream_body(lambda: part.read_chunk(1 << 16))
         raise ErrMissingParamFile
 
     async def _read_raw(self, request: web.Request) -> bytes:
-        data = bytearray()
-        async for chunk in request.content.iter_chunked(1 << 16):
-            data.extend(chunk)
-            if len(data) > MAX_BODY_SIZE:
-                raise ErrEntityTooLarge
-        return bytes(data)
+        # declared oversize -> 413 with ZERO body bytes read (the old path
+        # streamed up to the full cap before noticing)
+        length = request.content_length
+        if length is not None and length > MAX_BODY_SIZE:
+            raise ErrEntityTooLarge
+        it = request.content.iter_chunked(1 << 16)
+        return await _stream_body(it.__anext__)
 
 
 class FileSystemImageSource:
