@@ -113,8 +113,11 @@ bench-latency:
 bench-cache:
 	python bench_cache.py
 
-# headline throughput with tracing on vs off (cache-off zipf row); exits
-# nonzero on gross overhead or missing tracing response surfaces
+# headline throughput with tracing on vs off (cache-off zipf row), plus
+# the cost-plane rows (--cost-attribution ABBA overhead; hog-flood /topz
+# ranking with live-vs-offline bound_by agreement) and the 2-worker
+# fleet tail-sampling row; exits nonzero on gross overhead, missing
+# tracing response surfaces, or any cost/fleet gate breach
 bench-obs:
 	python bench_obs.py
 

@@ -636,7 +636,8 @@ def transport_ab():
     return [row], (0 if ok else 1)
 
 
-def link_projection(live_rows=None) -> list:
+def link_projection(live_rows=None, links=None, cores=None,
+                    overrides=None, quiet=False) -> list:
     """Co-located-link projection (VERDICT r4 next #1b): bridge the
     measured on-chip rate to projected END-TO-END serving throughput per
     link class, so "Nx on co-located hardware" is an evidenced
@@ -753,20 +754,39 @@ def link_projection(live_rows=None) -> list:
             if arm.get("host_ms_per_img", 0) > 0:
                 t["host_ms"] = host_fixed_ms + arm["host_ms_per_img"]
 
-    links = [
-        # (label, MB/s, fixed ms per drain) — tunnel numbers are MEASURED
-        ("tunnel_measured", 30.0, 60.0),
-        ("dcn_1GBps", 1000.0, 5.0),
-        ("pcie3_x16", 12000.0, 0.5),
-        ("colocated_pcie5", 48000.0, 0.2),
-    ]
+    # caller overrides (the live bound_by advisor's agreement gate in
+    # bench_obs.py feeds MEASURED per-request columns through the same
+    # min(link, chip, host) arithmetic): a single synthetic transport
+    # priced at the supplied wire/host/chip numbers, projected over the
+    # caller's link/core grid instead of the ladder above
+    if overrides:
+        if overrides.get("chip_rate"):
+            chip_rate = float(overrides["chip_rate"])
+            src = "override"
+        transports = {
+            "live": {
+                "wire_mb": float(overrides.get("wire_mb", wire_mb)),
+                "host_ms": float(overrides.get("host_ms", host_fixed_ms)),
+                "wire_src": "override",
+            },
+        }
+    if links is None:
+        links = [
+            # (label, MB/s, fixed ms per drain) — tunnel numbers are
+            # MEASURED
+            ("tunnel_measured", 30.0, 60.0),
+            ("dcn_1GBps", 1000.0, 5.0),
+            ("pcie3_x16", 12000.0, 0.5),
+            ("colocated_pcie5", 48000.0, 0.2),
+        ]
+    core_grid = tuple(cores) if cores else (1, 8, 32)
     out = []
     serving_batch = 16
     for transport, t in transports.items():
         for label, mbps, fixed_ms in links:
             link_rate = 1000.0 / (fixed_ms / serving_batch
                                   + t["wire_mb"] / mbps * 1000.0)
-            for cores in (1, 8, 32):
+            for cores in core_grid:
                 host_rate = cores * 1000.0 / t["host_ms"]
                 e2e = min(link_rate, chip_rate, host_rate)
                 bound = ("link" if e2e == link_rate
@@ -787,9 +807,11 @@ def link_projection(live_rows=None) -> list:
                     "vs_1core_cv2_baseline": round(e2e / (1000.0 / base_ms), 2),
                 }
                 out.append(row)
-                log(f"[dev] proj {transport:>6} {label:>16} cores={cores:<3} -> "
-                    f"{row['projected_req_per_s']:>8} req/s ({bound})")
-                print(json.dumps(row), flush=True)
+                if not quiet:
+                    log(f"[dev] proj {transport:>6} {label:>16} "
+                        f"cores={cores:<3} -> "
+                        f"{row['projected_req_per_s']:>8} req/s ({bound})")
+                    print(json.dumps(row), flush=True)
     return out
 
 

@@ -23,12 +23,24 @@ BENCH_OBS_FLEET_MAX_OVERHEAD_PCT (default 25 — p50 deltas on 1-2s
 slices are noisy; the criterion is "within noise", not a tight budget).
 The fleet row is archived to artifacts/bench_obs_fleet.jsonl.
 
+Two cost-plane rows ride along (archived to artifacts/bench_obs_cost.jsonl):
+
+  * attribution overhead — the same zipf harness ABBA-toggled on
+    --cost-attribution; the gate is paced p50 within
+    BENCH_OBS_COST_MAX_OVERHEAD_PCT (default 25 — the fleet row's
+    "within noise" criterion, not a tight budget).
+  * hog flood — a batch-class tenant floods beside paced interactive
+    traffic on a cost-armed server; /topz must rank the hog #1 by
+    chip-ms within one 10s window, and the live bound_by verdict must
+    agree with bench_device.link_projection fed the same measured
+    per-request profile.
+
 Prints one JSON line per row on stdout; human detail on stderr. Exits
 nonzero when the tracing ON arm lost more than
 BENCH_OBS_MAX_OVERHEAD_PCT (default 10 — a gross-regression gate
 tolerant of short-run noise; the acceptance criterion is <= 2% on a
 full-length run), when tracing surfaces are missing from responses, or
-when any fleet-row gate breaches.
+when any fleet-row or cost-row gate breaches.
 """
 
 from __future__ import annotations
@@ -319,6 +331,198 @@ def _fleet_row(duration: float, concurrency: int, jpeg: bytes) -> int:
     return 0 if ok else 1
 
 
+def _cost_overhead_row(duration: float, concurrency: int,
+                       variants: list) -> int:
+    """ABBA overhead row for --cost-attribution: the tracing row's zipf
+    cache-off harness, toggling only the cost plane. Gated on paced p50
+    (BENCH_OBS_COST_MAX_OVERHEAD_PCT, default 25 — the fleet scrape
+    row's "within noise" criterion: booking is a dict update plus a
+    ring-bucket add per request, so any real p50 signal here is a bug,
+    but p50 deltas on 1-2s slices are noisy)."""
+    from imaginary_tpu.web.config import ServerOptions
+
+    cost_max = float(os.environ.get("BENCH_OBS_COST_MAX_OVERHEAD_PCT", "25"))
+    slice_s = max(duration / 2.0, 1.0)
+    totals = {True: [0.0, [], 0], False: [0.0, [], 0]}  # rps-sum, lats, errs
+    for arm_on in (False, True, True, False):  # ABBA, as above
+        rps, lats, errs = asyncio.run(_arm(
+            ServerOptions(enable_url_source=True, cost_attribution=arm_on),
+            variants, slice_s, concurrency, check_headers=True))
+        totals[arm_on][0] += rps
+        totals[arm_on][1].extend(lats)
+        totals[arm_on][2] += errs
+    p50_off = pctl(totals[False][1], 0.50)
+    p50_on = pctl(totals[True][1], 0.50)
+    overhead = (100.0 * (p50_on - p50_off) / p50_off) if p50_off else 0.0
+    row = {
+        "metric": "obs_cost_attribution_overhead",
+        "rps": round(totals[True][0] / 2, 2),
+        "rps_cost_off": round(totals[False][0] / 2, 2),
+        "p50_ms": p50_on,
+        "p50_ms_cost_off": p50_off,
+        "p99_ms": pctl(totals[True][1], 0.99),
+        "p99_ms_cost_off": pctl(totals[False][1], 0.99),
+        "overhead_pct": round(overhead, 2),
+        "errors": totals[True][2] + totals[False][2],
+    }
+    print(json.dumps(row))
+    os.makedirs("artifacts", exist_ok=True)
+    with open(os.path.join("artifacts", "bench_obs_cost.jsonl"), "a") as f:
+        f.write(json.dumps(dict(row, ts=round(time.time(), 3))) + "\n")
+    if overhead > cost_max:
+        print(f"[obs-bench] FAIL: cost-attribution p50 overhead "
+              f"{overhead:.1f}% exceeds {cost_max:.1f}% gate", file=sys.stderr)
+        return 1
+    print(f"[obs-bench] cost-attribution overhead {overhead:.1f}% "
+          f"(p50 {p50_off:.2f} -> {p50_on:.2f} ms)", file=sys.stderr)
+    return 0
+
+
+_HOG_QOS = json.dumps({
+    "default": {"class": "standard"},
+    "tenants": [
+        {"name": "hog", "class": "batch", "api_keys": ["k-hog"]},
+        {"name": "inter", "class": "interactive", "api_keys": ["k-inter"]},
+    ],
+})
+
+
+async def _hog_arm(duration: float, concurrency: int, jpeg: bytes):
+    """Flood a batch-class tenant beside paced interactive traffic on a
+    cost-armed server; return per-tenant counts plus the /topz and
+    /health views, both read before teardown while the whole flood still
+    sits inside the live 10s accounting window."""
+    from imaginary_tpu.web.config import ServerOptions
+
+    options = ServerOptions(cost_attribution=True, qos_config=_HOG_QOS)
+    server_runner, app, base = await _start_server(options)
+    try:
+        url = f"{base}/resize?width=300&height=200"
+        conn = aiohttp.TCPConnector(limit=0)
+        counts = {"hog": 0, "inter": 0, "errors": 0}
+        async with aiohttp.ClientSession(connector=conn) as session:
+            for _ in range(4):  # warmup: XLA compiles outside the flood
+                async with session.post(url, data=jpeg,
+                                        headers={"API-Key": "k-hog"}) as r:
+                    await r.read()
+            deadline = time.monotonic() + duration
+
+            async def worker(name: str, key: str, pace_s: float):
+                while time.monotonic() < deadline:
+                    try:
+                        async with session.post(
+                                url, data=jpeg,
+                                headers={"API-Key": key}) as res:
+                            await res.read()
+                            if res.status == 200:
+                                counts[name] += 1
+                            else:
+                                counts["errors"] += 1
+                    except Exception:
+                        counts["errors"] += 1
+                    if pace_s:
+                        await asyncio.sleep(pace_s)
+
+            tasks = [worker("hog", "k-hog", 0.0)
+                     for _ in range(max(2, concurrency - 2))]
+            tasks += [worker("inter", "k-inter", 0.2) for _ in range(2)]
+            await asyncio.gather(*tasks)
+            async with session.get(f"{base}/topz") as res:
+                topz_status, topz = res.status, await res.json()
+            async with session.get(f"{base}/health") as res:
+                health = await res.json()
+        return counts, topz_status, topz, health
+    finally:
+        await server_runner.cleanup()
+
+
+def _hog_flood_row(duration: float, concurrency: int, jpeg: bytes) -> int:
+    """Cost-plane acceptance row: /topz must rank the flooding batch
+    tenant #1 by chip-ms within one 10s window, and the live bound_by
+    verdict must agree with bench_device.link_projection fed the same
+    measured per-request profile — the live EWMAs and the offline
+    projection are the same min(link, chip, host) arithmetic, and this
+    row pins that they cannot drift apart."""
+    import bench_device
+
+    flood_s = min(max(duration, 2.0), 8.0)  # must fit one 10s window
+    counts, topz_status, topz, health = asyncio.run(
+        _hog_arm(flood_s, concurrency, jpeg))
+
+    adv = (health.get("capacity") or {}).get("bound_by") or {}
+    win = ((topz.get("windows") or {}).get("10s") or {}) \
+        if topz_status == 200 and isinstance(topz, dict) else {}
+    ranked = win.get("by_chip_ms") or []
+    top_tenant = ranked[0].get("tenant", "") if ranked else ""
+
+    # offline verdict: the advisor's measured per-request profile pushed
+    # through link_projection as a single "live" link/core point. mbps =
+    # 1000/ms_per_mb makes wire_mb/mbps*1000 == wire_mb*ms_per_mb, so
+    # both sides price the link identically.
+    offline_bound = ""
+    needed = ("drain_floor_ms", "device_ms_per_mb", "wire_mb_per_req",
+              "host_ms_per_req", "device_ms_per_req")
+    if all(adv.get(k) for k in needed):
+        proj = bench_device.link_projection(
+            links=[("live", 1000.0 / adv["device_ms_per_mb"],
+                    adv["drain_floor_ms"])],
+            cores=(int(adv.get("host_workers", 1)),),
+            overrides={"wire_mb": adv["wire_mb_per_req"],
+                       "host_ms": adv["host_ms_per_req"],
+                       "chip_rate": 1000.0 / adv["device_ms_per_req"]},
+            quiet=True)
+        if proj:
+            offline_bound = proj[0]["bound_by"]
+
+    row = {
+        "metric": "obs_cost_hog_flood",
+        "flood_s": round(flood_s, 1),
+        "hog_requests": counts["hog"],
+        "inter_requests": counts["inter"],
+        "errors": counts["errors"],
+        "topz_top_chip_ms": top_tenant,
+        "bound_by_live": adv.get("verdict", ""),
+        "bound_by_offline": offline_bound,
+        "advisor_window": adv.get("window", ""),
+    }
+    print(json.dumps(row))
+    os.makedirs("artifacts", exist_ok=True)
+    with open(os.path.join("artifacts", "bench_obs_cost.jsonl"), "a") as f:
+        f.write(json.dumps(dict(row, ts=round(time.time(), 3))) + "\n")
+
+    ok = True
+    if topz_status != 200 or not ranked:
+        print(f"[obs-bench] FAIL: /topz unusable under flood "
+              f"(status={topz_status}, ranked={len(ranked)})",
+              file=sys.stderr)
+        ok = False
+    elif top_tenant != "hog":
+        print(f"[obs-bench] FAIL: /topz 10s chip-ms leader is "
+              f"{top_tenant!r}, want the flooding tenant 'hog' "
+              f"(rows={ranked[:3]})", file=sys.stderr)
+        ok = False
+    if not (counts["hog"] > counts["inter"] > 0):
+        print(f"[obs-bench] FAIL: flood shape wrong (hog={counts['hog']}, "
+              f"inter={counts['inter']} — want hog > inter > 0)",
+              file=sys.stderr)
+        ok = False
+    if adv.get("verdict", "unknown") == "unknown":
+        print(f"[obs-bench] FAIL: live bound_by advisor returned no "
+              f"verdict under flood (advisor={adv})", file=sys.stderr)
+        ok = False
+    elif offline_bound != adv["verdict"]:
+        print(f"[obs-bench] FAIL: live bound_by {adv['verdict']!r} "
+              f"disagrees with offline link_projection "
+              f"{offline_bound!r} (advisor={adv})", file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"[obs-bench] hog-flood row: /topz leader 'hog' "
+              f"({counts['hog']} hog vs {counts['inter']} interactive), "
+              f"bound_by live == offline == {adv['verdict']!r}",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main() -> int:
     from imaginary_tpu.web.config import ServerOptions
 
@@ -372,11 +576,19 @@ def main() -> int:
         print(f"[obs-bench] tracing overhead {overhead_pct:.1f}% "
               f"({rps_off:.1f} -> {rps_on:.1f} req/s)", file=sys.stderr)
 
+    print(f"[obs-bench] cost row: --cost-attribution on vs off, "
+          f"ABBA-interleaved", file=sys.stderr)
+    cost_rc = _cost_overhead_row(duration, concurrency, variants)
+
+    print("[obs-bench] hog-flood row: batch hog vs interactive tenant, "
+          "/topz ranking + live-vs-offline bound_by", file=sys.stderr)
+    hog_rc = _hog_flood_row(duration, concurrency, base_jpeg)
+
     print(f"[obs-bench] fleet row: 2 workers, sample={_FLEET_SAMPLE}, "
           f"fault every {_FAULT_EVERY}th request, admin scrape under load",
           file=sys.stderr)
     fleet_rc = _fleet_row(duration, concurrency, base_jpeg)
-    return rc or fleet_rc
+    return rc or cost_rc or hog_rc or fleet_rc
 
 
 if __name__ == "__main__":

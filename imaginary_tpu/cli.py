@@ -546,6 +546,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve /debugz runtime introspection (task dump, "
                         "executor/cache snapshots, slow-request exemplars, "
                         "one-shot profiler trigger)")
+    p.add_argument("--cost-attribution", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_COST_ATTRIBUTION"),
+                   help="per-tenant cost attribution + capacity plane "
+                        "(obs/cost.py): cost vectors booked per tenant x "
+                        "qos_class x route x op, a capacity block in "
+                        "/health, /topz top-K consumers, live bound_by "
+                        "advisor, imaginary_tpu_cost_*/_utilization_* "
+                        "metrics; off = none of it exists (parity)")
+    p.add_argument("--cost-topk", type=int,
+                   default=_env_int("IMAGINARY_TPU_COST_TOPK", 20),
+                   help="cost-attribution sketch width: at most K distinct "
+                        "tenant/op label values; the rest fold into 'other'")
+    p.add_argument("--cost-windows",
+                   default=_env_str("IMAGINARY_TPU_COST_WINDOWS",
+                                    "10s,1m,5m"),
+                   help="cost rollup windows over the 1s ring: ascending "
+                        "CSV of <n>s/<n>m spans (max 6, each <= 1h)")
     p.add_argument("--distributed", action="store_true",
                    default=_env_bool("IMAGINARY_TPU_DISTRIBUTED"),
                    help="join a multi-host fleet (jax.distributed.initialize before meshing)")
@@ -609,6 +626,15 @@ def options_from_args(args) -> ServerOptions:
 
         try:
             load_slo_config(args.slo_config)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+    if args.cost_attribution:
+        # same boot-time discipline: a typo'd window spec must refuse to
+        # start, not silently attribute into malformed windows
+        from imaginary_tpu.obs.cost import parse_windows
+
+        try:
+            parse_windows(args.cost_windows)
         except ValueError as e:
             raise SystemExit(str(e)) from None
 
@@ -706,6 +732,9 @@ def options_from_args(args) -> ServerOptions:
         wide_events_sample=min(1.0, max(0.0, args.wide_events_sample)),
         slo_config=args.slo_config,
         enable_debug=args.enable_debug,
+        cost_attribution=args.cost_attribution,
+        cost_topk=max(1, args.cost_topk),
+        cost_windows=args.cost_windows,
         distributed=args.distributed,
         coordinator_address=args.coordinator_address,
         num_processes=args.num_processes or None,

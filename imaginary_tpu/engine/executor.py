@@ -44,6 +44,7 @@ from imaginary_tpu.engine import host_exec
 from imaginary_tpu.engine import lanes as lanes_mod
 from imaginary_tpu.engine.devhealth import DeviceHealthRegistry
 from imaginary_tpu.engine.timing import COPIES, LANE_TIMES, TIMES, WIRE
+from imaginary_tpu.obs import cost as obs_cost
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.ops import chain as chain_mod
 from imaginary_tpu.ops.buckets import bucket_shape
@@ -1844,13 +1845,24 @@ class Executor:
         fault domain and the chunk re-places onto survivors."""
         now = time.monotonic()
         for it in items:
+            bf_ms = (it.t_close - it.t) * 1000.0
+            dw_ms = (now - it.t_close) * 1000.0
             TIMES.record("queue_wait", (now - it.t) * 1000.0)
-            TIMES.record("batch_form", (it.t_close - it.t) * 1000.0)
-            TIMES.record("dispatch_wait", (now - it.t_close) * 1000.0)
-            LANE_TIMES.record(lane.idx, "batch_form",
-                              (it.t_close - it.t) * 1000.0)
-            LANE_TIMES.record(lane.idx, "dispatch_wait",
-                              (now - it.t_close) * 1000.0)
+            TIMES.record("batch_form", bf_ms)
+            TIMES.record("dispatch_wait", dw_ms)
+            LANE_TIMES.record(lane.idx, "batch_form", bf_ms)
+            LANE_TIMES.record(lane.idx, "dispatch_wait", dw_ms)
+            # per-request attribution: the collector thread carries no
+            # trace contextvar, so TIMES.record's span fan-out cannot
+            # see these — stamp the item's own trace directly (the
+            # _stamp_attempts cross-thread pattern). This is what puts
+            # batch_form/dispatch_wait on Server-Timing and the slow
+            # ring, plus the lane id on device-path exemplars.
+            tr = it.trace
+            if tr is not None:
+                tr.add_span("batch_form", bf_ms)
+                tr.add_span("dispatch_wait", dw_ms)
+                tr.annotate(lane=lane.idx)
         sharded = (self._lane_sharding is not None
                    and len(items) >= self._shard_min())
         spatial = (not sharded and len(items) == 1
@@ -2056,8 +2068,27 @@ class Executor:
                     drain_ms = (time.monotonic() - t0) * 1000.0
                     per_item = drain_ms / max(1, n_items)
                     self._note_device_ok(lane.idx, latency_ms=drain_ms)
-                    lane.note_service(per_item)
+                    lane.note_service(per_item, n_items)
                     LANE_TIMES.record(lane.idx, "drain", per_item)
+                    cost_armed = obs_cost.active() is not None
+                    if cost_armed:
+                        # busy-fraction source: the drain's WALL time
+                        # (per-item samples undercount by the batch
+                        # factor). Cost-gated so the off path's lane
+                        # surface stays byte-identical.
+                        LANE_TIMES.record(lane.idx, "drain_busy", drain_ms)
+                    for c in chunks:
+                        for it in c[3]:
+                            tr = it.trace
+                            if tr is None:
+                                continue
+                            # the measured per-item service — the same
+                            # number that settles this lane's owed ledger
+                            tr.add_span("drain", per_item)
+                            if cost_armed:
+                                tr.accumulate("cost_device_ms", per_item)
+                                tr.accumulate("cost_wire_bytes",
+                                              it.wire_mb * 1e6)
                     for host_y, c in zip(fetched, chunks):
                         _y, arrs, plans, sub, cidx, _tl = c
                         try:
@@ -2225,9 +2256,18 @@ class Executor:
             # the queue_wait split (engine/timing.py): formation delay up
             # to the chunk close the collector stamped, everything after
             # that — time behind in-flight chunks — as dispatch_wait
+            bf_ms = (it.t_close - it.t) * 1000.0
+            dw_ms = (now - it.t_close) * 1000.0
             TIMES.record("queue_wait", (now - it.t) * 1000.0)
-            TIMES.record("batch_form", (it.t_close - it.t) * 1000.0)
-            TIMES.record("dispatch_wait", (now - it.t_close) * 1000.0)
+            TIMES.record("batch_form", bf_ms)
+            TIMES.record("dispatch_wait", dw_ms)
+            # per-request attribution (see _lane_dispatch): the collector
+            # thread has no trace contextvar, so stamp the item's trace
+            # directly for Server-Timing / slow-ring span parity
+            tr = it.trace
+            if tr is not None:
+                tr.add_span("batch_form", bf_ms)
+                tr.add_span("dispatch_wait", dw_ms)
         cache_before = chain_mod.cache_size()
         try:
             # chaos site: delay() models a slow device/link (the collector
@@ -2765,11 +2805,32 @@ class Executor:
             # the marginal cost, still registers as expensive under load).
             t_done = time.monotonic()
             drain_ms = (t_done - t0) * 1000.0
+            per_item_drain = drain_ms / max(1, n_items)
             if not cold:
-                TIMES.record("drain", drain_ms / max(1, n_items))
+                TIMES.record("drain", per_item_drain)
                 if t_ready is not None:
                     TIMES.record("device_wait", (t_ready - t0) * 1000.0 / max(1, n_items))
                     TIMES.record("d2h", (t_done - t_ready) * 1000.0 / max(1, n_items))
+            # per-request drain span + cost stamps (fetcher thread has no
+            # trace contextvar — same cross-thread pattern as the
+            # dispatch-side stamps); cold drains still attribute to the
+            # requests that paid them even though they don't feed the EWMA
+            cost_armed = obs_cost.active() is not None
+            if cost_armed:
+                # global-path busy booked under the sentinel lane -1
+                # (rendered as lane="all"); cost-gated for parity
+                LANE_TIMES.record(-1, "drain_busy", drain_ms)
+            for c in chunks:
+                for it in c[3]:
+                    tr = it.trace
+                    if tr is None:
+                        continue
+                    tr.add_span("drain", per_item_drain)
+                    if c[4] is not None:
+                        tr.annotate(device=c[4])
+                    if cost_armed:
+                        tr.accumulate("cost_device_ms", per_item_drain)
+                        tr.accumulate("cost_wire_bytes", it.wire_mb * 1e6)
             # the link moved the PADDED batches (power-of-two launch padding
             # duplicates items in both directions), so charge the padded
             # count, not just the real items — c[1] is the padded arr list

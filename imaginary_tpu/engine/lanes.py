@@ -46,7 +46,8 @@ class Lane:
     """
 
     __slots__ = ("idx", "device", "queue", "fetch_queue", "owed", "inflight",
-                 "dispatches", "ewma_ms", "affinity_hits", "affinity_misses",
+                 "dispatches", "ewma_ms", "served_ms", "served_items",
+                 "affinity_hits", "affinity_misses",
                  "active", "lock", "collector", "fetcher")
 
     def __init__(self, idx: int, device, max_inflight: int = 2):
@@ -64,6 +65,10 @@ class Lane:
         self.inflight = 0
         self.dispatches = 0  # device calls launched on this lane
         self.ewma_ms = 0.0  # per-item service ms, launch -> drain complete
+        # cumulative service the chip actually delivered: the capacity
+        # plane's per-lane busy signal and an operator's lifetime view
+        self.served_ms = 0.0
+        self.served_items = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
         # False while this chip is quarantined: the scheduler skips the
@@ -83,13 +88,17 @@ class Lane:
         with self.lock:
             return (self.owed + 1) * max(self.ewma_ms, 1.0)
 
-    def note_service(self, ms_per_item: float) -> None:
-        """Fold one drain's per-item latency into the service EWMA."""
+    def note_service(self, ms_per_item: float, n_items: int = 1) -> None:
+        """Fold one drain's per-item latency into the service EWMA and
+        book the drain's wall time (`ms_per_item * n_items`) into the
+        cumulative served ledger."""
         with self.lock:
             if self.ewma_ms <= 0.0:
                 self.ewma_ms = ms_per_item
             else:
                 self.ewma_ms = 0.7 * self.ewma_ms + 0.3 * ms_per_item
+            self.served_ms += ms_per_item * n_items
+            self.served_items += n_items
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -104,6 +113,8 @@ class Lane:
             "inflight": inflight,
             "dispatches": self.dispatches,
             "ewma_ms": round(ewma, 3),
+            "served_ms": round(self.served_ms, 3),
+            "served_items": self.served_items,
             "affinity_hits": hits,
             "affinity_misses": misses,
             "affinity_hit_ratio": round(hits / total, 3) if total else 0.0,

@@ -18,6 +18,7 @@ import threading
 
 import numpy as np
 
+from imaginary_tpu.obs import cost as _obs_cost
 from imaginary_tpu.obs import histogram as _obs_hist
 from imaginary_tpu.obs import trace as _obs_trace
 
@@ -93,6 +94,14 @@ class StageTimes:
                     "p99_ms": round(float(window[int(0.99 * (n - 1))]), 3),
                 }
         return out
+
+    def totals(self) -> dict:
+        """{stage: (count, cumulative_ms)} — the monotonic view the
+        capacity plane's utilization sampler diffs between snapshots
+        (busy fractions need sums, not the ring percentiles)."""
+        with self._lock:
+            return {s: (self._count[s], self._sum[s])
+                    for s in STAGES if self._count[s]}
 
     def reset(self) -> None:
         with self._lock:
@@ -202,6 +211,16 @@ class CopyLedger:
         with self._lock:
             self._bytes[stage] = self._bytes.get(stage, 0) + int(nbytes)
             self._copies[stage] = self._copies.get(stage, 0) + int(copies)
+        # Cost-attribution stamp (obs/cost.py): when the plane is armed
+        # AND the booking thread carries a request context (handler
+        # tasks + host-pool workers do), the same bytes attribute to the
+        # request's cost vector. Off by default: no plane, no stamp.
+        if _obs_cost.active() is not None:
+            tr = _obs_trace.current()
+            if tr is not None:
+                tr.accumulate("cost_copied_bytes", int(nbytes))
+                if stage == "cache_hit":
+                    tr.accumulate("cost_cache_bytes", int(nbytes))
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -236,26 +255,35 @@ class LaneStageTimes:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._cells: dict = {}  # (lane, stage) -> [count, ewma_ms]
+        # (lane, stage) -> [count, ewma_ms, total_ms]; the cumulative
+        # total feeds the capacity plane's per-lane busy fractions
+        self._cells: dict = {}
 
     def record(self, lane: int, stage: str, ms: float) -> None:
         with self._lock:
             cell = self._cells.get((lane, stage))
             if cell is None:
-                self._cells[(lane, stage)] = [1, ms]
+                self._cells[(lane, stage)] = [1, ms, ms]
             else:
                 cell[0] += 1
                 cell[1] = 0.8 * cell[1] + 0.2 * ms
+                cell[2] += ms
 
     def snapshot(self) -> dict:
-        """{lane: {stage: {count, ewma_ms}}} — empty when no lane ever
-        recorded (the single-lane parity path)."""
+        """{lane: {stage: {count, ewma_ms, total_ms}}} — empty when no
+        lane ever recorded (the single-lane parity path)."""
         with self._lock:
             out: dict = {}
-            for (lane, stage), (count, ewma) in self._cells.items():
+            for (lane, stage), (count, ewma, total) in self._cells.items():
                 out.setdefault(lane, {})[stage] = {
-                    "count": count, "ewma_ms": round(ewma, 3)}
+                    "count": count, "ewma_ms": round(ewma, 3),
+                    "total_ms": round(total, 3)}
             return out
+
+    def totals(self) -> dict:
+        """{(lane, stage): cumulative_ms} for utilization delta math."""
+        with self._lock:
+            return {k: cell[2] for k, cell in self._cells.items()}
 
     def reset(self) -> None:
         with self._lock:

@@ -509,12 +509,41 @@ def build_fleetz(supervisor_view: dict, health_by_worker: dict,
         entry["stale"] = idx in missed or h is None
         entry["health"] = h
         workers[str(idx)] = entry
-    return {
+    out = {
         "ts": round(now, 3),
         "workers": workers,
         "scraped": sorted(set(health_by_worker)),
         "missed": sorted(missed),
     }
+    # fleet-merged capacity summary (obs/cost.py): window cost totals
+    # summed across workers + each worker's live bound_by verdict side
+    # by side. Present only when some worker is running with
+    # --cost-attribution — the per-worker block's presence propagates
+    # the armed/parity signal up to /fleetz.
+    caps = {
+        idx: h["capacity"] for idx, h in sorted(health_by_worker.items())
+        if isinstance(h, dict) and isinstance(h.get("capacity"), dict)
+    }
+    if caps:
+        fleet_windows: dict = {}
+        verdicts: dict = {}
+        folds = 0
+        for idx, cap in caps.items():
+            folds += int(cap.get("folds", 0) or 0)
+            for label, vec in (cap.get("windows") or {}).items():
+                agg = fleet_windows.setdefault(label, {})
+                for k, v in vec.items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = round(agg.get(k, 0) + v, 3)
+            bound = cap.get("bound_by") or {}
+            verdicts[str(idx)] = bound.get("verdict", "unknown")
+        out["capacity"] = {
+            "workers": sorted(caps),
+            "folds": folds,
+            "windows": fleet_windows,
+            "bound_by": verdicts,
+        }
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -141,6 +141,13 @@ def debug_payload(service) -> dict:
             # with --slo-config unset: the block's presence IS the
             # armed/parity signal.
             payload["slo"] = slo.snapshot()
+        cost = getattr(service, "cost", None)
+        if cost is not None:
+            # per-tenant cost windows + utilization + live bound_by
+            # (obs/cost.py) — the same dict /health serves, so the two
+            # surfaces cannot drift. Absent with --cost-attribution
+            # unset: the block's presence IS the armed/parity signal.
+            payload["capacity"] = cost.snapshot()
     return payload
 
 

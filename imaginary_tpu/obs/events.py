@@ -32,7 +32,21 @@ Schema (stable field names — tests/test_obs.py pins them):
   hedge         won | lost (only when a hedged host twin launched)
   tenant        resolved qos tenant name (only with --qos-config)
   qos_class     interactive | standard | batch (only with --qos-config)
-  spans         [{name, start_ms, dur_ms}] full timeline
+  spans         [{name, start_ms, dur_ms}] full timeline — includes the
+                device-path stage splits batch_form / dispatch_wait /
+                drain stamped per item by engine/executor.py (the same
+                splits Server-Timing carries)
+  lane          serving-lane index for device-path requests (mesh
+                policy armed); exemplar mining in /debugz keys on it
+  device        chip index for global-queue device dispatches
+  cost_device_ms / cost_wire_bytes / cost_copied_bytes /
+  cost_cache_bytes   per-request cost-vector stamps (only with
+                --cost-attribution; obs/cost.py books the same numbers
+                into the tenant ledger)
+  loop_lag_ms   most recent event-loop lag probe sample, stamped only
+                when it exceeds obs/looplag.WIDE_EVENT_THRESHOLD_MS —
+                a slow request with this field was slowed by a blocked
+                loop, not the device path
   worker/epoch  serving process index + fencing generation — merged
                 streams from N workers are attributable, and the LB
                 retry contract (PR 11) correlates a retried request's

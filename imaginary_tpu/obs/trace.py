@@ -135,6 +135,27 @@ class RequestTrace:
         with self._lock:
             self.fields.update(fields)
 
+    def accumulate(self, key: str, delta: float) -> None:
+        """Thread-safe additive field — the cost-attribution stamps
+        (cost_device_ms, cost_wire_bytes, ...) sum contributions from
+        executor/ledger threads here. Unlike annotate/add_span this is
+        NOT gated on `enabled`: cost booking must work with tracing
+        off, and the fields only reach a wide event via to_event, which
+        tracing-off requests never build."""
+        with self._lock:
+            self.fields[key] = self.fields.get(key, 0.0) + delta
+
+    def field(self, key: str, default=None):
+        with self._lock:
+            return self.fields.get(key, default)
+
+    def span_sum(self, names) -> float:
+        """Summed duration of every span whose name is in `names` —
+        how the middleware derives a request's host-pool-ms from its
+        probe/decode/encode/host_spill spans at booking time."""
+        with self._lock:
+            return sum(s.dur_ms for s in self.spans if s.name in names)
+
     def duration_ms(self) -> float:
         return (time.monotonic() - self.t0) * 1000.0
 

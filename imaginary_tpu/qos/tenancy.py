@@ -129,6 +129,12 @@ class QosPolicy:
                 return ten
         return self.default
 
+    def tenant_names(self) -> tuple:
+        """Every configured tenant name, default first — the cost plane
+        pre-seeds its top-K sketch with these so a policy-file tenant
+        never reports as `other` before its first request."""
+        return (self.default.name,) + tuple(t.name for t in self.tenants)
+
     # -- knob lookups ------------------------------------------------------
 
     def any_rate(self) -> bool:

@@ -37,6 +37,12 @@ Rules (one thin module per rule under tools/rules/):
   ITPU010  sampled_reason literals and imaginary_tpu_slo_* metric names
            <-> their declared registries (SAMPLED_REASONS in
            obs/events.py, SLO_METRICS in obs/slo.py)
+  ITPU011  lane ledger charges balance (per-lane owed accounting, the
+           multi-chip analogue of ITPU003)
+  ITPU012  tenant/op/route-derived metric label values route through
+           the bounded-cardinality normalizer (normalize_label in
+           obs/cost.py), and every literal label kind is declared in
+           _LABEL_KINDS
 
 Suppression grammar (same-line, or a standalone comment covering the
 next code line); the reason is REQUIRED — a blanket suppression is

@@ -12,6 +12,7 @@ from imaginary_tpu.tools.rules import (
     context_propagation,
     failpoint_registry,
     future_guard,
+    label_cardinality,
     lane_ledger,
     ledger,
     metrics_exposition,
@@ -32,4 +33,5 @@ RULES = (
     context_propagation,
     slot_protocol,
     obs_registry,
+    label_cardinality,
 )

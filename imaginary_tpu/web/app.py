@@ -72,25 +72,51 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     from imaginary_tpu.obs import slo as slo_mod
 
     slo = slo_mod.from_options(o)
+    # Cost-attribution + capacity plane (obs/cost.py): built ONCE here —
+    # the trace middleware books per-request cost vectors into it, the
+    # service exposes it on /health //metrics //debugz //topz and binds
+    # its live signal sources. None when --cost-attribution is unset:
+    # every consumer takes its parity path (from_options also installs
+    # the module-level plane the engine stamps check, so disarming an
+    # app disarms the stamps).
+    from imaginary_tpu.obs import cost as cost_mod
+
+    cost = cost_mod.from_options(o)
+    if cost is not None and qos is not None:
+        cost.seed_tenants(qos.tenant_names())
     # trace middleware is OUTERMOST: it assigns request identity and
     # installs the contextvar trace before the access log (which reads
     # the id) and everything inside it runs
     app = web.Application(
         middlewares=[trace_middleware(o, log_stream, qos=qos,
-                                      pressure=governor, slo=slo),
+                                      pressure=governor, slo=slo,
+                                      cost=cost),
                      access_log_middleware(o.log_level, log_stream)]
         + build_middlewares(o, qos=qos),
         client_max_size=1 << 26,  # 64 MB body cap (ref: source_body.go:13)
     )
-    service = ImageService(o, qos=qos, pressure=governor, slo=slo)
+    service = ImageService(o, qos=qos, pressure=governor, slo=slo,
+                           cost=cost)
     app["service"] = service
     app["options"] = o
 
     prefix = o.path_prefix.rstrip("/")
 
+    async def on_startup(app):
+        # event-loop lag probe (obs/looplag.py): always on while the
+        # server runs — loop scheduling delay is the one host signal no
+        # stage ledger covers
+        from imaginary_tpu.obs import looplag
+
+        app["_looplag_task"] = looplag.start()
+
     async def on_cleanup(app):
+        from imaginary_tpu.obs import looplag
+
+        looplag.stop(app.get("_looplag_task"))
         await service.close()
 
+    app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
 
     def add(path, handler, methods=("GET", "POST")):
@@ -111,6 +137,9 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
     # arm a new spec (empty body disarms). Same gate as /debugz.
     add(prefix + "/debugz/failpoints", partial(_debugz_failpoints, o),
         methods=("GET", "PUT"))
+    # top-K resource consumers per window (404 unless --cost-attribution
+    # armed a plane — same presence-is-the-signal gate as /debugz)
+    add(prefix + "/topz", partial(_topz, service, o), methods=("GET",))
 
     for name in ALL_OPERATIONS:
         route = "/" + (name.lower() if name == "watermarkImage" else name)
@@ -159,6 +188,16 @@ async def _debugz(service, o, request):
     from imaginary_tpu.obs.debugz import debug_payload
 
     return web.json_response(debug_payload(service))
+
+
+async def _topz(service, o, request):
+    cost = getattr(service, "cost", None) if service is not None else None
+    if cost is None:
+        from imaginary_tpu.errors import ErrNotFound
+        from imaginary_tpu.web.middleware import error_response
+
+        return error_response(request, ErrNotFound, o)
+    return web.json_response(cost.topz())
 
 
 async def _debugz_profile(o, request):

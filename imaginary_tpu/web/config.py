@@ -276,6 +276,15 @@ class ServerOptions:
     # slow-request exemplars, one-shot profiler). Off by default: it is an
     # information surface an internet-facing deployment must opt into.
     enable_debug: bool = False
+    # Per-tenant cost attribution + capacity plane (obs/cost.py). Off by
+    # default (parity): no cost ring, no /topz, no capacity block, no
+    # imaginary_tpu_cost_*/imaginary_tpu_utilization_* families.
+    cost_attribution: bool = False
+    # Top-K sketch width: at most this many tenant (and op) label values
+    # stay distinct; everything past K folds into `other`.
+    cost_topk: int = 20
+    # Rollup windows over the 1s cost ring, ascending `<n>s|<n>m` CSV.
+    cost_windows: str = "10s,1m,5m"
     # multi-host (DCN) fleet join: jax.distributed.initialize before meshing
     distributed: bool = False
     coordinator_address: str = ""

@@ -82,7 +82,7 @@ class ImageService:
     parallelism), and the source registry."""
 
     def __init__(self, o: ServerOptions, qos=None, pressure=None,
-                 slo=None):
+                 slo=None, cost=None):
         self.options = o
         # multi-tenant QoS policy (imaginary_tpu/qos/): create_app builds
         # it once and passes it in; direct constructors (tests, benches)
@@ -110,6 +110,18 @@ class ImageService:
 
             slo = slo_mod.from_options(o)
         self.slo = slo
+        # cost-attribution plane (obs/cost.py): same pattern — create_app
+        # builds and shares it (the trace middleware books into it),
+        # direct constructors derive it from the options (which also
+        # installs the module plane the engine stamps check). None = off
+        # (parity: no capacity block, no /topz, no cost families).
+        if cost is None and o.cost_attribution:
+            from imaginary_tpu.obs import cost as cost_mod
+
+            cost = cost_mod.from_options(o)
+            if cost is not None and self.qos is not None:
+                cost.seed_tenants(self.qos.tenant_names())
+        self.cost = cost
         # content-addressed cache tiers (imaginary_tpu/cache.py): result
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
@@ -228,6 +240,13 @@ class ImageService:
         self._inflight = 0  # guarded by _inflight_lock (pool threads mutate)
         self._service_ewma_ms = 20.0
         self._inflight_lock = threading.Lock()
+        if self.cost is not None:
+            # wire the capacity plane's live signal sources: the executor
+            # (drain-floor + ms/MB EWMAs for the bound_by advisor) and a
+            # host-pool occupancy view
+            self.cost.bind(
+                executor=self.executor,
+                host_view=lambda: (self._pool_workers, self._inflight))
 
     def estimated_queue_ms(self) -> float:
         """Expected queueing delay for a NEW request: host-pool backlog
@@ -746,7 +765,9 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
     stats = get_health_stats(service.executor if service else None,
                              qos=service.qos if service else None,
                              pressure=service.pressure if service else None,
-                             slo=service.slo if service else None)
+                             slo=service.slo if service else None,
+                             cost=getattr(service, "cost", None)
+                             if service else None)
     if service is not None:
         # the admission-control signal (estimated_queue_ms): operators
         # watching overload want the same number the 503 gate reads
@@ -774,6 +795,14 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
         arena = native_backend.arena_stats()
         if arena is not None:
             stats["arena"] = arena
+    # event-loop lag probe (obs/looplag.py): absent until the sampler
+    # has taken a sample (a bare worker that never ran a loop reports
+    # nothing, matching the other presence-is-the-signal blocks)
+    from imaginary_tpu.obs import looplag
+
+    loop_lag = looplag.snapshot()
+    if loop_lag is not None:
+        stats["eventLoop"] = loop_lag
     return stats
 
 

@@ -28,7 +28,7 @@ def _rss_mb() -> float:
 
 
 def get_health_stats(executor=None, qos=None, pressure=None,
-                     slo=None) -> dict:
+                     slo=None, cost=None) -> dict:
     import gc
 
     stats = {
@@ -92,6 +92,14 @@ def get_health_stats(executor=None, qos=None, pressure=None,
         # surfaces cannot drift. Absent with --slo-config unset — the
         # block's presence IS the armed/parity signal.
         stats["slo"] = slo.snapshot()
+    if cost is not None:
+        # cost attribution + capacity plane (obs/cost.py): per-tenant
+        # cost windows, utilization timelines, live bound_by verdict;
+        # /metrics renders the same block as imaginary_tpu_cost_* /
+        # imaginary_tpu_utilization_* so the two surfaces cannot drift.
+        # Absent with --cost-attribution unset — the block's presence IS
+        # the armed/parity signal.
+        stats["capacity"] = cost.snapshot()
     from imaginary_tpu.engine.timing import TIMES
 
     stage_times = TIMES.snapshot()

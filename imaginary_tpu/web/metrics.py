@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import re
 
+from imaginary_tpu.obs.cost import normalize_label
 from imaginary_tpu.obs.histogram import REGISTRY, escape_label_value
 
 # Occupancy/level metrics mirrored from /health; everything else in the
@@ -96,6 +97,8 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
     fleet: dict = {}
     ingress: dict = {}
     slo: dict = {}
+    capacity: dict = {}
+    event_loop: dict = {}
     oom_splits = None
     for key, value in stats.items():
         if key == "executor" and isinstance(value, dict):
@@ -150,6 +153,13 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
             arena = value
         elif key == "slo" and isinstance(value, dict):
             slo = value
+        elif key == "capacity" and isinstance(value, dict):
+            # cost attribution + capacity plane (obs/cost.py snapshot,
+            # only with --cost-attribution) — deferred: tenant-labeled
+            # cost counters + utilization gauges
+            capacity = value
+        elif key == "eventLoop" and isinstance(value, dict):
+            event_loop = value
         elif key == "cache" and isinstance(value, dict):
             # cache tier counters (imaginary_tpu/cache.py): hit/miss/
             # eviction per tier + singleflight coalescing + 304s
@@ -445,7 +455,7 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
     slo_burn: list = []
     slo_budget: list = []
     for route, entry in sorted((slo.get("routes") or {}).items()):
-        rlab = escape_label_value(route)
+        rlab = escape_label_value(normalize_label("route", route))
         for kind in ("availability", "latency"):
             block = entry.get(kind) or {}
             for window in ("5m", "1h"):
@@ -465,6 +475,75 @@ def render_metrics(stats: dict, exemplars: bool = False) -> str:
         x.emit("imaginary_tpu_slo_error_budget_remaining", v, labels,
                help_text="Fraction of the error budget left this hour "
                          "per route/objective (hour-as-period proxy).")
+    # Cost attribution families (obs/cost.py, only with
+    # --cost-attribution): per-tenant cumulative cost-vector counters —
+    # one loop per family so samples stay contiguous. Tenant values are
+    # already sketch-bounded but still route through the normalizer so
+    # the emit site itself is cardinality-safe (itpucheck ITPU012).
+    if capacity:
+        cost_tenants = sorted((capacity.get("tenants") or {}).items())
+        _cost_help = {
+            "device_ms": "Chip milliseconds (measured drain service) "
+                         "booked per tenant.",
+            "host_ms": "Host-pool codec milliseconds (probe/decode/"
+                       "encode/host_spill spans) booked per tenant.",
+            "wire_bytes": "Device-link bytes (H2D + D2H) booked per "
+                          "tenant.",
+            "copied_bytes": "Host bytes copied (byte-touch ledger) "
+                            "booked per tenant.",
+            "cache_bytes": "Response bytes served from cache hits "
+                           "booked per tenant.",
+            "requests": "Requests booked into the cost ledger per "
+                        "tenant.",
+        }
+        for field, help_text in _cost_help.items():
+            for tenant, vec in cost_tenants:
+                tlab = escape_label_value(normalize_label("tenant", tenant))
+                x.emit(f"imaginary_tpu_cost_{field}_total",
+                       vec.get(field, 0), f'tenant="{tlab}"',
+                       mtype="counter", help_text=help_text)
+        x.emit("imaginary_tpu_cost_folds_total", capacity.get("folds", 0),
+               mtype="counter",
+               help_text="Attribution series folded into the `other` "
+                         "label by the top-K cardinality sketch.")
+        x.emit("imaginary_tpu_cost_booked_total", capacity.get("booked", 0),
+               mtype="counter",
+               help_text="Requests booked into the cost attribution "
+                         "ring.")
+        util = capacity.get("utilization") or {}
+        for kind, v in sorted((util.get("wait_cum_ms") or {}).items()):
+            x.emit("imaginary_tpu_utilization_wait_ms_total", v,
+                   f'kind="{escape_label_value(kind)}"', mtype="counter",
+                   help_text="Cumulative idle-gap attribution per kind "
+                             "(batch_form|dispatch_wait|link_stall|"
+                             "drain) in milliseconds.")
+        for lane, v in sorted((util.get("lanes") or {}).items()):
+            x.emit("imaginary_tpu_utilization_lane_busy", v,
+                   f'lane="{escape_label_value(lane)}"',
+                   help_text="Per-lane drain busy fraction over the "
+                             "last scrape delta window.")
+        if "chip_busy" in util:
+            x.emit("imaginary_tpu_utilization_chip_busy",
+                   util["chip_busy"],
+                   help_text="Mean chip busy fraction over the last "
+                             "scrape delta window.")
+        if "host_pool" in util:
+            x.emit("imaginary_tpu_utilization_host_pool",
+                   util["host_pool"],
+                   help_text="Host codec pool occupancy "
+                             "(inflight/workers), instant.")
+        if "link" in util:
+            x.emit("imaginary_tpu_utilization_link", util["link"],
+                   help_text="Device-link occupancy over the last "
+                             "scrape delta window (wire MB priced at "
+                             "the live ms/MB EWMA).")
+    if event_loop:
+        x.emit("imaginary_tpu_event_loop_lag_last_seconds",
+               float(event_loop.get("lagMsLast", 0.0)) / 1000.0,
+               help_text="Most recent event-loop lag probe sample.")
+        x.emit("imaginary_tpu_event_loop_lag_max_seconds",
+               float(event_loop.get("lagMsMax", 0.0)) / 1000.0,
+               help_text="Max event-loop lag observed since start.")
     for labels, v in stage_total:
         x.emit("imaginary_tpu_stage_total", v, labels, mtype="counter",
                help_text="Samples recorded per pipeline stage.")

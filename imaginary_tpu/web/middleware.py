@@ -19,8 +19,10 @@ from urllib.parse import urlencode
 from aiohttp import web
 
 from imaginary_tpu import deadline as deadline_mod
+from imaginary_tpu.obs import cost as obs_cost
 from imaginary_tpu.obs import events as obs_events
 from imaginary_tpu.obs import histogram as obs_hist
+from imaginary_tpu.obs import looplag as obs_looplag
 from imaginary_tpu.obs import trace as obs_trace
 from imaginary_tpu.obs.debugz import SLOW as obs_slow
 
@@ -125,7 +127,7 @@ def _route_label(request: web.Request) -> str:
 
 
 def trace_middleware(o: ServerOptions, events_out=None, qos=None,
-                     pressure=None, slo=None):
+                     pressure=None, slo=None, cost=None):
     """Outermost middleware: request identity + trace lifecycle.
 
     Assigns/propagates X-Request-ID and W3C traceparent, installs the
@@ -219,6 +221,33 @@ def trace_middleware(o: ServerOptions, events_out=None, qos=None,
             obs_hist.REQUESTS_TOTAL.inc((route, f"{status // 100}xx"))
             if slo is not None:
                 slo.observe(route, status, elapsed)
+            if cost is not None and cost.should_book(route):
+                # assemble and book this request's cost vector: the
+                # engine-stamped accumulators (device-ms, wire/copied/
+                # cache bytes) plus host-pool-ms derived from the
+                # host-stage spans. Booked with tracing off too — cost
+                # truth must not depend on the tracing A/B switch.
+                host_ms = tr.span_sum(obs_cost.HOST_STAGES)
+                if host_ms and tr.enabled:
+                    tr.accumulate("cost_host_ms", host_ms)
+                ten = tr.tenant
+                cost.book(
+                    tenant=ten.name if ten is not None else "default",
+                    qos_class=ten.klass if ten is not None else "-",
+                    route=route,
+                    op=route.strip("/").split("/")[-1] or "-",
+                    device_ms=tr.field("cost_device_ms", 0.0),
+                    host_ms=host_ms,
+                    wire_bytes=tr.field("cost_wire_bytes", 0.0),
+                    copied_bytes=tr.field("cost_copied_bytes", 0.0),
+                    cache_bytes=tr.field("cost_cache_bytes", 0.0),
+                )
+            if tr.enabled:
+                # event-loop lag stamp (obs/looplag.py): a slow request
+                # during a lag spike carries the evidence on the event
+                lag_ms = obs_looplag.last_ms()
+                if lag_ms >= obs_looplag.WIDE_EVENT_THRESHOLD_MS:
+                    tr.annotate(loop_lag_ms=round(lag_ms, 3))
             if resp is not None:
                 resp.headers["X-Request-ID"] = tr.request_id
                 if tr.enabled:

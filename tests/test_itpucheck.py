@@ -468,6 +468,88 @@ class TestObsRegistry:
         assert findings == []
 
 
+class TestLabelCardinality:
+    _COST = (
+        "_LABEL_KINDS = ('tenant', 'op', 'route', 'qos_class')\n"
+        "def normalize_label(kind, value):\n"
+        "    return value\n"
+    )
+
+    def test_trips_unnormalized_guarded_label(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "obs/cost.py": self._COST,
+            "web/metrics.py": (
+                "def render(x, tenants, esc):\n"
+                "    for t, v in tenants.items():\n"
+                "        x.emit('imaginary_tpu_cost_requests_total', v,\n"
+                "               f'tenant=\"{esc(t)}\"', mtype='counter',\n"
+                "               help_text='h')\n"
+            ),
+        }, rules=["ITPU012"])
+        assert _rules_hit(findings) == {"ITPU012"}
+        assert "tenant=" in findings[0].message
+        assert "normalize_label" in findings[0].message
+
+    def test_trips_undeclared_kind(self, tmp_path):
+        findings, _ = _scan(tmp_path, {
+            "obs/cost.py": self._COST,
+            "m.py": (
+                "from obs.cost import normalize_label\n"
+                "def f(v):\n"
+                "    return normalize_label('flavor', v)\n"
+            ),
+        }, rules=["ITPU012"])
+        assert _rules_hit(findings) == {"ITPU012"}
+        assert "'flavor'" in findings[0].message
+        assert "_LABEL_KINDS" in findings[0].message
+
+    def test_normalized_chain_passes(self, tmp_path):
+        # both spellings pass: inline call, and a variable assigned from
+        # an escape(normalize_label(...)) chain — the live metrics.py
+        # idiom for the slo route labels
+        findings, _ = _scan(tmp_path, {
+            "obs/cost.py": self._COST,
+            "web/metrics.py": (
+                "from obs.cost import normalize_label\n"
+                "def render(x, tenants, routes, esc, v):\n"
+                "    for t in tenants:\n"
+                "        lab = esc(normalize_label('tenant', t))\n"
+                "        x.emit('imaginary_tpu_cost_requests_total', v,\n"
+                "               f'tenant=\"{lab}\"', mtype='counter',\n"
+                "               help_text='h')\n"
+                "    for r in routes:\n"
+                "        x.emit('imaginary_tpu_slo_burn_rate', v,\n"
+                "               f'route=\"{esc(normalize_label(\"route\", r))}\"',\n"
+                "               help_text='h')\n"
+            ),
+        }, rules=["ITPU012"])
+        assert findings == []
+
+    def test_unguarded_keys_stay_free(self, tmp_path):
+        # class=/lane=/stage= are bounded enums: no normalizer required
+        findings, _ = _scan(tmp_path, {
+            "obs/cost.py": self._COST,
+            "web/metrics.py": (
+                "def render(x, classes, esc, v):\n"
+                "    for c in classes:\n"
+                "        x.emit('imaginary_tpu_qos_shed_total', v,\n"
+                "               f'class=\"{esc(c)}\"', mtype='counter',\n"
+                "               help_text='h')\n"
+            ),
+        }, rules=["ITPU012"])
+        assert findings == []
+
+    def test_missing_registry_is_a_finding(self, tmp_path):
+        # normalize_label used but no _LABEL_KINDS registry in the tree:
+        # the contract has no owner
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "from obs.cost import normalize_label\n"
+            "def f(v):\n"
+            "    return normalize_label('tenant', v)\n"
+        )}, rules=["ITPU012"])
+        assert _rules_hit(findings) == {"ITPU012"}
+
+
 # -- suppression grammar ------------------------------------------------------
 
 
@@ -541,8 +623,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 11 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 11
+        # all 12 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 12
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

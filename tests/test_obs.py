@@ -236,6 +236,20 @@ class TestServerTiming:
 
         run(ServerOptions(), fn)
 
+    def test_device_path_stage_splits_reach_the_header(self):
+        # PR 9/15 promised batch_form / dispatch_wait / drain stage
+        # splits; the collector threads carry no trace contextvar, so
+        # only the executor's direct per-item add_span stamps can get
+        # them here (ISSUE 18 satellite)
+        async def fn(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            st = res.headers.get("Server-Timing", "")
+            for name in ("batch_form", "dispatch_wait", "drain"):
+                assert re.search(rf"{name};dur=\d+(\.\d+)?", st), (name, st)
+
+        run(ServerOptions(), fn)
+
     def test_tracing_disabled_still_sets_request_id(self):
         async def fn(client, _origin, _app):
             res = await client.post("/resize?width=100", data=jpg())
@@ -898,4 +912,330 @@ class TestSloSurfaces:
             text = await (await client.get("/metrics")).text()
             assert "imaginary_tpu_slo_" not in text
 
+
+# --- cost attribution & capacity plane (ISSUE 18) -----------------------------
+
+class TestCostPlaneUnit:
+    def test_parse_windows(self):
+        from imaginary_tpu.obs import cost as cost_mod
+
+        assert cost_mod.parse_windows("10s,1m,5m") == (
+            ("10s", 10), ("1m", 60), ("5m", 300))
+        for bad in ("", " , ", "10x", "10s,5s", "0s", "120m",
+                    "1s,2s,3s,4s,5s,6s,7s"):
+            with pytest.raises(ValueError):
+                cost_mod.parse_windows(bad)
+
+    def test_space_saving_fold_is_deterministic(self):
+        from imaginary_tpu.obs.cost import SpaceSaving
+
+        sk = SpaceSaving(2)
+        assert sk.offer("a") is None
+        assert sk.offer("a") is None
+        assert sk.offer("b") is None
+        # full table: the newcomer evicts the minimum entry — ties break
+        # by (count, name), so replay order alone decides nothing
+        assert sk.offer("c") == "b"
+        assert sk.tracked("a") and sk.tracked("c") and not sk.tracked("b")
+        # the newcomer inherited the victim's count floor
+        assert dict(sk.top())["c"] == 2.0
+
+    def test_booking_windows_and_topz_ranking(self):
+        from imaginary_tpu.obs.cost import CostPlane
+
+        t = [1000.0]
+        plane = CostPlane(topk=4, windows="10s,1m", clock=lambda: t[0])
+        for _ in range(3):
+            plane.book("hog", "batch", "/process", "process",
+                       device_ms=100.0, wire_bytes=5e6)
+        for _ in range(2):
+            plane.book("inter", "interactive", "/resize", "resize",
+                       device_ms=1.0, host_ms=2.0, wire_bytes=1e4)
+        snap = plane.snapshot()
+        assert snap["booked"] == 5
+        assert set(snap["windows"]) == {"10s", "1m"}
+        assert snap["windows"]["10s"]["requests"] == 5
+        assert snap["windows"]["10s"]["device_ms"] == pytest.approx(302.0)
+        assert snap["tenants"]["hog"]["wire_bytes"] == 15_000_000
+        topz = plane.topz()
+        ranked = topz["windows"]["10s"]["by_chip_ms"]
+        assert [r["tenant"] for r in ranked] == ["hog", "inter"]
+        assert ranked[0]["chip_ms"] == pytest.approx(300.0)
+        # host-ms ranking only lists tenants that actually burned host time
+        assert [r["tenant"] for r in topz["windows"]["10s"]["by_host_ms"]] \
+            == ["inter"]
+        # 11 seconds later the 10s window has forgotten, the 1m one not
+        t[0] += 11.0
+        plane.book("late", "-", "/resize", "resize", device_ms=7.0)
+        snap = plane.snapshot()
+        assert snap["windows"]["10s"]["requests"] == 1
+        assert snap["windows"]["10s"]["device_ms"] == pytest.approx(7.0)
+        assert snap["windows"]["1m"]["requests"] == 6
+
+    def test_topk_folds_into_other(self):
+        from imaginary_tpu.obs.cost import OTHER, CostPlane
+
+        t = [1000.0]
+        plane = CostPlane(topk=2, windows="10s", clock=lambda: t[0])
+        plane.book("a", "-", "/x", "x", device_ms=5.0)
+        plane.book("a", "-", "/x", "x", device_ms=5.0)
+        plane.book("b", "-", "/x", "x", device_ms=5.0)
+        plane.book("c", "-", "/x", "x", device_ms=5.0)  # evicts b
+        snap = plane.snapshot()
+        assert snap["folds"] == 1
+        assert set(snap["tenants"]) == {"a", "c", OTHER}
+        # b's cumulative vector folded into `other`
+        assert snap["tenants"][OTHER]["device_ms"] == pytest.approx(5.0)
+        assert plane.normalize("tenant", "b") == OTHER
+        assert plane.normalize("tenant", "a") == "a"
+        # route/qos_class kinds pass through; unknown kinds raise
+        assert plane.normalize("route", "/whatever") == "/whatever"
+        with pytest.raises(ValueError):
+            plane.normalize("flavor", "x")
+
+    def test_seeded_tenants_never_report_other(self):
+        from imaginary_tpu.obs.cost import CostPlane
+
+        plane = CostPlane(topk=4, windows="10s")
+        plane.seed_tenants(("gold", "bronze"))
+        assert plane.normalize("tenant", "gold") == "gold"
+        assert plane.normalize("tenant", "stranger") == "other"
+
+    def test_should_book_skips_infra_routes(self):
+        from imaginary_tpu.obs.cost import CostPlane
+
+        plane = CostPlane()
+        for route in ("/", "/health", "/metrics", "/topz", "/fleetz",
+                      "/api/health", "/debugz"):
+            assert not plane.should_book(route), route
+        for route in ("/resize", "/process", "/api/crop"):
+            assert plane.should_book(route), route
+
+    def test_advisor_unknown_without_traffic(self):
+        from imaginary_tpu.obs.cost import CostPlane
+
+        plane = CostPlane(windows="10s")
+        verdict = plane.advise()
+        assert verdict["verdict"] == "unknown"
+
+    def test_advisor_verdict_argmin(self):
+        from imaginary_tpu.obs.cost import SERVING_BATCH, CostPlane
+
+        class _Ex:
+            _drain_floor_ms = 80.0
+            _device_ms_per_mb = 2.0
+
+        t = [1000.0]
+        plane = CostPlane(topk=4, windows="10s", clock=lambda: t[0])
+        plane.bind(executor=_Ex(), host_view=lambda: (4, 0))
+        plane.book("t", "-", "/process", "process",
+                   device_ms=20.0, host_ms=1.0, wire_bytes=10e6)
+        out = plane.advise()
+        # link: 80/16 + 10*2 = 25 ms/req; chip: 20 ms/req; host: 1/4
+        assert out["serving_batch"] == SERVING_BATCH
+        assert out["link_rate"] == pytest.approx(1000.0 / 25.0)
+        assert out["chip_rate"] == pytest.approx(50.0)
+        assert out["verdict"] == "link"
+        assert out["e2e_rate"] == pytest.approx(40.0)
+
+    def test_from_options_parity_and_install(self):
+        from imaginary_tpu.obs import cost as cost_mod
+
+        assert cost_mod.from_options(ServerOptions()) is None
+        assert cost_mod.active() is None
+        plane = cost_mod.from_options(
+            ServerOptions(cost_attribution=True, cost_topk=7))
+        try:
+            assert plane is not None and plane.topk == 7
+            assert cost_mod.active() is plane
+            # armed: normalize_label delegates to the plane
+            assert cost_mod.normalize_label("tenant", "ghost") == "other"
+        finally:
+            cost_mod.install(None)
+        # disarmed: identity passthrough, but kinds still validated
+        assert cost_mod.normalize_label("tenant", "ghost") == "ghost"
+        with pytest.raises(ValueError):
+            cost_mod.normalize_label("flavor", "x")
+
+
+class TestCostSurfaces:
+    def test_armed_health_metrics_topz_debugz(self):
+        async def fn(client, _origin, _app):
+            for _ in range(2):
+                res = await client.post("/resize?width=100", data=jpg())
+                assert res.status == 200
+            health = await (await client.get("/health")).json()
+            cap = health["capacity"]
+            assert cap["booked"] >= 2
+            assert set(cap["windows"]) == {"10s", "1m", "5m"}
+            assert cap["tenants"]["default"]["requests"] >= 2
+            assert "verdict" in cap["bound_by"]
+            assert "wait_cum_ms" in cap["utilization"]
+            # scrape twice: utilization busy fractions are deltas
+            # between snapshots, so the second scrape carries them
+            await client.get("/metrics")
+            text = await (await client.get("/metrics")).text()
+            types, samples = parse_exposition_strict(text)
+            names = {n for n, _, _ in samples}
+            for field in ("device_ms", "host_ms", "wire_bytes",
+                          "copied_bytes", "cache_bytes", "requests"):
+                fam = f"imaginary_tpu_cost_{field}_total"
+                assert fam in names, fam
+                assert types[fam] == "counter"
+            assert "imaginary_tpu_cost_folds_total" in names
+            assert "imaginary_tpu_cost_booked_total" in names
+            assert types["imaginary_tpu_utilization_wait_ms_total"] \
+                == "counter"
+            assert {labels["kind"] for n, labels, _ in samples
+                    if n == "imaginary_tpu_utilization_wait_ms_total"} \
+                == {"batch_form", "dispatch_wait", "link_stall", "drain"}
+            assert types["imaginary_tpu_utilization_chip_busy"] == "gauge"
+            assert "imaginary_tpu_utilization_host_pool" in names
+            # every cost family is tenant-labeled with the booked tenant
+            reqs = [(labels, v) for n, labels, v in samples
+                    if n == "imaginary_tpu_cost_requests_total"]
+            assert any(labels.get("tenant") == "default" and v >= 2
+                       for labels, v in reqs)
+            topz = await client.get("/topz")
+            assert topz.status == 200
+            body = await topz.json()
+            assert body["k"] == 20
+            assert body["windows"]["5m"]["totals"]["requests"] >= 2
+            ranked = body["windows"]["5m"]["by_chip_ms"]
+            assert ranked and ranked[0]["tenant"] == "default"
+            debug = await (await client.get("/debugz")).json()
+            assert "capacity" in debug
+
+        run(ServerOptions(cost_attribution=True, enable_debug=True), fn)
+
+    def test_off_by_default_parity(self):
+        collected = {}
+
+        async def armed(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            collected["armed"] = await res.read()
+
+        async def off(client, _origin, _app):
+            res = await client.post("/resize?width=100", data=jpg())
+            assert res.status == 200
+            collected["off"] = await res.read()
+            health = await (await client.get("/health")).json()
+            assert "capacity" not in health
+            text = await (await client.get("/metrics")).text()
+            assert "imaginary_tpu_cost_" not in text
+            assert "imaginary_tpu_utilization_" not in text
+            topz = await client.get("/topz")
+            assert topz.status == 404
+            debug = await (await client.get("/debugz")).json()
+            assert "capacity" not in debug
+
+        run(ServerOptions(cost_attribution=True), armed)
+        run(ServerOptions(enable_debug=True), off)
+        # the image path is byte-identical with the plane disarmed
+        assert collected["armed"] == collected["off"]
+
+    def test_capacity_render_is_strict_and_normalized(self):
+        # synthetic capacity block straight through render_metrics: the
+        # exposition stays strict and tenant label values are escaped
+        from imaginary_tpu.web.metrics import render_metrics
+
+        text = render_metrics({
+            "capacity": {
+                "topk": 2, "folds": 3, "booked": 9,
+                "windows": {"10s": {"device_ms": 1.0, "requests": 2}},
+                "tenants": {
+                    'we"ird': {"device_ms": 1.5, "host_ms": 0.0,
+                               "wire_bytes": 10, "copied_bytes": 4,
+                               "cache_bytes": 0, "requests": 2},
+                },
+                "utilization": {
+                    "age_s": 1.0,
+                    "wait_cum_ms": {"batch_form": 1.0, "drain": 2.0},
+                    "lanes": {"0": 0.5, "all": 0.1},
+                    "chip_busy": 0.3, "host_pool": 0.25, "link": 0.1,
+                },
+                "bound_by": {"verdict": "chip"},
+            },
+            "eventLoop": {"lagMsLast": 12.0, "lagMsMax": 80.0,
+                          "samples": 5},
+        })
+        types, samples = parse_exposition_strict(text)
+        assert types["imaginary_tpu_cost_device_ms_total"] == "counter"
+        tenants = {labels["tenant"] for n, labels, _ in samples
+                   if n == "imaginary_tpu_cost_device_ms_total"}
+        # the strict parser keeps label values raw: the quote arrived
+        # backslash-escaped on the wire, which is the point
+        assert tenants == {'we\\"ird'}
+        lane = {labels["lane"]: v for n, labels, v in samples
+                if n == "imaginary_tpu_utilization_lane_busy"}
+        assert lane == {"0": 0.5, "all": 0.1}
+        gauges = {n: v for n, _l, v in samples}
+        assert gauges["imaginary_tpu_utilization_chip_busy"] == 0.3
+        assert gauges["imaginary_tpu_event_loop_lag_last_seconds"] \
+            == pytest.approx(0.012)
+        assert gauges["imaginary_tpu_event_loop_lag_max_seconds"] \
+            == pytest.approx(0.080)
+
+
+class TestLoopLag:
+    def test_probe_samples_and_snapshot(self):
+        from imaginary_tpu.obs import looplag
+
+        async def probe():
+            task = looplag.start(0.01)
+            await asyncio.sleep(0.08)
+            looplag.stop(task)
+
+        asyncio.run(probe())
+        snap = looplag.snapshot()
+        assert snap is not None
+        assert snap["samples"] >= 1
+        assert snap["lagMsMax"] >= snap["lagMsLast"] >= 0.0
+        assert looplag.last_ms() == pytest.approx(
+            snap["lagMsLast"], abs=1e-3)
+
+    def test_health_carries_event_loop_block(self):
+        async def fn(client, _origin, _app):
+            # the probe runs at 4 Hz from app startup; wait one period
+            await asyncio.sleep(0.3)
+            health = await (await client.get("/health")).json()
+            assert health["eventLoop"]["samples"] >= 1
+
         run(ServerOptions(), fn)
+
+
+class TestFleetCapacityMerge:
+    def test_fleetz_merges_capacity_across_workers(self):
+        from imaginary_tpu.obs.aggregate import build_fleetz
+
+        def health(verdict, device_ms, folds=0):
+            return {
+                "worker": 0, "epoch": 1,
+                "capacity": {
+                    "folds": folds,
+                    "windows": {"10s": {"device_ms": device_ms,
+                                        "requests": 2}},
+                    "bound_by": {"verdict": verdict},
+                },
+            }
+
+        view = {0: {"pid": 10, "alive": True}, 1: {"pid": 11, "alive": True}}
+        out = build_fleetz(
+            view,
+            {0: health("chip", 10.0, folds=1),
+             1: health("link", 5.0, folds=2)},
+            missed=set(), now=123.0)
+        cap = out["capacity"]
+        assert cap["workers"] == [0, 1]
+        assert cap["folds"] == 3
+        assert cap["windows"]["10s"]["device_ms"] == pytest.approx(15.0)
+        assert cap["windows"]["10s"]["requests"] == 4
+        assert cap["bound_by"] == {"0": "chip", "1": "link"}
+
+    def test_fleetz_parity_without_capacity(self):
+        from imaginary_tpu.obs.aggregate import build_fleetz
+
+        out = build_fleetz({0: {"pid": 10}}, {0: {"worker": 0}},
+                           missed=set(), now=123.0)
+        assert "capacity" not in out
