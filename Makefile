@@ -27,7 +27,9 @@ gate: lint native-entropy dct-parity test chaos
 	  { echo "bench_device.py policy A/B failed - snapshot NOT green"; exit 1; }
 	BENCH_PLATFORM=cpu python bench_stages.py || \
 	  { echo "bench_stages.py byte-touch/spill gates failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device/stages benches all pass"
+	BENCH_DURATION=4 BENCH_THREADS=8 BENCH_COHERENCE_ONLY=1 python bench_workers.py || \
+	  { echo "bench_workers.py fleet-coherence gates failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device/stages/coherence benches all pass"
 
 # Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10 + ISSUE 11): the
 # deadline/failpoint/devhealth/pressure/integrity/fleet suites, then
@@ -53,9 +55,14 @@ gate: lint native-entropy dct-parity test chaos
 # revived worker is epoch-fenced: reads ok, publishes refused), and a
 # SIGHUP rolling restart under open-loop load (100% availability,
 # per-index epochs monotonic); counters archived to
-# artifacts/chaos_fleet.json.
+# artifacts/chaos_fleet.json. Rows 11-12 (ISSUE 19) arm --fleet-coherence
+# on the same fleet shape: SIGKILL the digest owner mid-coalesce (>=99%
+# availability, fleet singleflight bound on publishes, claim table at
+# rest after one sweep) and a SIGSTOP zombie owner (its identity refused
+# at claim_acquire, a deposed live holder read STALE and swept); counters
+# archived to artifacts/chaos_ownership.json.
 chaos:
-	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py tests/test_fleet.py -q -m 'not slow'
+	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py tests/test_fleet.py tests/test_ownership.py -q -m 'not slow'
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \
 	  XLA_FLAGS="--xla_force_host_platform_device_count=2" \
 	  JAX_PLATFORMS=cpu python bench_chaos.py || \

@@ -1524,6 +1524,317 @@ def _lanes_chip_loss_row() -> tuple:
     return 0, row
 
 
+# --- rows 11-12 (ISSUE 19): digest ownership under owner death ---------------
+
+
+def _spawn_claim_holder(fleet_path: str) -> subprocess.Popen:
+    """A claim holder that wins a known digest's claim and stalls — its
+    exclusive byte lock stays kernel-held until we SIGKILL it. Wears a
+    high worker index no serving worker occupies, so deposing it (epoch
+    stamp) fences only this holder."""
+    code = (
+        "import hashlib, time\n"
+        "from imaginary_tpu.fleet.shmcache import ShmCache\n"
+        f"w = ShmCache({fleet_path!r}, create=False, worker=50, epoch=0)\n"
+        "c = w.claim_acquire(hashlib.sha256(b'chaos-claim').digest())\n"
+        "print('claimed' if c.won else 'lost', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    return subprocess.Popen([sys.executable, "-c", code], cwd=ROOT,
+                            stdout=subprocess.PIPE)
+
+
+async def _ownership_kill_soak(duration: float, concurrency: int) -> dict:
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    # hop budget sized for cold-compile first waves (a 1-cpu host can
+    # serialize several compiles ahead of a hop); coalesce ON so the
+    # local flight groups and the fleet claims compose under the storm
+    fleet = _Fleet(extra_args=["--fleet-coherence", "--cache-coalesce",
+                               "--fleet-hop-ms", "15000"])
+    counts: dict = {}
+    out = {"ok": 0, "fail": 0, "waves": 0, "respawned": False}
+    try:
+        await fleet.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            workers0 = await fleet.wait_workers(session)
+            victim_pid = workers0[1]["pid"]
+
+            async def storm(seconds: float) -> None:
+                # wave storm: every wave is N CONCURRENT IDENTICAL
+                # requests to a FRESH url — each wave is one coalesce
+                # group per worker and (fleet-wide) one claim, so the
+                # publish count meters duplicate executions directly
+                deadline = time.monotonic() + seconds
+                i = 0
+                while time.monotonic() < deadline:
+                    u = fleet.url(i % 64)
+                    oks = await asyncio.gather(
+                        *[_lb_get(session, u, counts)
+                          for _ in range(concurrency)])
+                    for ok in oks:
+                        out["ok" if ok else "fail"] += 1
+                    out["waves"] += 1
+                    i += 1
+
+            async def kill_mid_storm():
+                await asyncio.sleep(max(duration / 3, 1.0))
+                os.kill(victim_pid, signal.SIGKILL)
+                print(f"[chaos] ownership-kill: SIGKILLed worker pid "
+                      f"{victim_pid} mid-coalesce", file=sys.stderr)
+
+            await asyncio.gather(storm(max(duration, 4.0)), kill_mid_storm())
+            respawned = False
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                try:
+                    h = await fleet.health(session)
+                    if h["worker"] == 1 and h["pid"] != victim_pid:
+                        respawned = True
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            out["respawned"] = respawned
+            out["per_pid"] = await _fleet_counters(fleet, session)
+            # ledgers at rest, against the LIVE file: after one sweep no
+            # claim entry may still read live or dead
+            client = ShmCache(fleet.fleet_path, create=False, worker=62,
+                              epoch=0)
+            try:
+                out["claims_swept"] = client.claim_sweep()
+                out["claim_scan"] = client.claim_scan()
+            finally:
+                client.close()
+    finally:
+        await fleet.stop()
+    out["counts"] = counts
+    return out
+
+
+def _ownership_kill_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_ownership_kill_soak(duration, concurrency))
+    total = got["ok"] + got["fail"]
+    per_pid = got.get("per_pid", {})
+    publishes = sum(v.get("publishes", 0) for v in per_pid.values())
+    corrupt_served = sum(v.get("corrupt_served", 0)
+                         for v in per_pid.values())
+    coh = [v.get("coherence", {}) for v in per_pid.values()]
+
+    def csum(field):
+        return sum(c.get(field, 0) for c in coh)
+
+    # serve_forwarded counts too: it proves a request crossed the IPC hop
+    # and was served by the owner even when the SENDER's clock ran out
+    # first (slow-host compile storms book those hops as forward_fails)
+    activity = (csum("forwards") + csum("serve_forwarded")
+                + csum("claim_waits") + csum("waiter_hits")
+                + csum("redispatches") + csum("local_fallbacks"))
+    distinct = min(got["waves"], 64)
+    row = {
+        "metric": "chaos_ownership_kill",
+        "requests": total,
+        "ok": got["ok"],
+        "ok_ratio": round(got["ok"] / total, 4) if total else 0.0,
+        "waves": got["waves"],
+        "distinct_urls": distinct,
+        "publishes": publishes,
+        "respawned": got["respawned"],
+        "corrupt_served_total": corrupt_served,
+        "coherence": {f: csum(f) for f in
+                      ("forwards", "forward_fails", "serve_forwarded",
+                       "claim_waits", "waiter_hits", "waiter_timeouts",
+                       "redispatches", "local_fallbacks")},
+        "claims_swept": got.get("claims_swept"),
+        "claim_scan": got.get("claim_scan"),
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if total == 0:
+        fails.append("ownership kill storm produced zero requests")
+    if total and got["ok"] / total < 0.99:
+        fails.append(f"availability {got['ok']}/{total} below 99% under "
+                     "digest-owner SIGKILL")
+    if not got["respawned"]:
+        fails.append("killed digest owner never respawned")
+    if corrupt_served:
+        fails.append(f"{corrupt_served} corrupt-byte serves (tripwire)")
+    if activity == 0:
+        fails.append("coherence layer never exercised (no forwards, "
+                     "claims or fallbacks booked)")
+    # duplicates <= waiters: each wave is one digest; the singleflight
+    # bound allows at most the wave itself plus the bounded fail-open
+    # duplicates (owner death, hop timeout) — 2x + slack covers a kill
+    # landing mid-wave on every URL without ever permitting N-x blowup
+    if publishes > 2 * distinct + 8:
+        fails.append(f"{publishes} publishes for {distinct} distinct "
+                     "digests — fleet singleflight did not hold")
+    scan = got.get("claim_scan") or {}
+    if scan.get("live", 1) != 0 or scan.get("dead", 1) != 0:
+        fails.append(f"claim table not at rest after sweep: {scan}")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (ownership SIGKILL): {got['ok']}/{total} ok over "
+          f"{got['waves']} waves, {publishes} publishes for {distinct} "
+          f"digests, owner respawned, coherence activity {activity}, "
+          "claim table at rest", file=sys.stderr)
+    return 0, row
+
+
+async def _ownership_zombie_soak(duration: float, concurrency: int) -> dict:
+    from imaginary_tpu.fleet.shmcache import ShmCache
+
+    fleet = _Fleet(
+        extra_args=["--fleet-coherence"],
+        extra_env={
+            "IMAGINARY_TPU_SUPERVISOR_PROBE_INTERVAL": "0.3",
+            "IMAGINARY_TPU_SUPERVISOR_PROBE_TIMEOUT": "1.0",
+            "IMAGINARY_TPU_SUPERVISOR_LIVENESS_TIMEOUT": "4.0",
+            "IMAGINARY_TPU_SUPERVISOR_HANG_GRACE": "2.0",
+            "IMAGINARY_TPU_SUPERVISOR_BOOT_GRACE": "20.0",
+        })
+    counts: dict = {}
+    out = {"replaced": False, "zombie_exited": False, "fence": {},
+           "stale": {}, "ok": 0, "fail": 0}
+    try:
+        await fleet.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            workers0 = await fleet.wait_workers(session)
+            await asyncio.sleep(3.0)
+            zpid, zepoch = workers0[1]["pid"], workers0[1]["epoch"]
+            print(f"[chaos] ownership-zombie: SIGSTOP worker 1 (pid {zpid}, "
+                  f"epoch {zepoch})", file=sys.stderr)
+            os.kill(zpid, signal.SIGSTOP)
+            end = time.monotonic() + 90.0
+            new_epoch = None
+            while time.monotonic() < end:
+                try:
+                    h = await fleet.health(session, timeout=1.5)
+                    if h["worker"] == 1 and h["pid"] != zpid \
+                            and h["epoch"] > zepoch:
+                        new_epoch = h["epoch"]
+                        out["replaced"] = True
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            # fence, against the LIVE file: a claimant wearing the
+            # zombie's identity must be refused at acquire — a deposed
+            # owner can never become the fleet's executor for a digest
+            zc = ShmCache(fleet.fleet_path, create=False, worker=1,
+                          epoch=zepoch)
+            try:
+                import hashlib
+
+                c = zc.claim_acquire(hashlib.sha256(b"zombie-bid").digest())
+                try:
+                    out["fence"] = {
+                        "old_epoch": zepoch, "new_epoch": new_epoch,
+                        "won": c.won, "busy": c.busy,
+                        "fenced_claims": zc.stats.fenced_claims,
+                    }
+                finally:
+                    zc.claim_release(c)
+            finally:
+                zc.close()
+            # stale detection: a live-but-deposed holder (SIGSTOP shape:
+            # kernel lock still held) must read STALE to the fleet, not
+            # busy — and one sweep reclaims the entry
+            holder = _spawn_claim_holder(fleet.fleet_path)
+            live = ShmCache(fleet.fleet_path, create=False, worker=63,
+                            epoch=0)
+            try:
+                assert b"claimed" in holder.stdout.readline()
+                import hashlib
+
+                k = hashlib.sha256(b"chaos-claim").digest()
+                live.stamp_epoch(50, 9)  # depose the stalled holder
+                c = live.claim_acquire(k)
+                try:
+                    out["stale"] = {
+                        "won": c.won, "busy": c.busy, "stale": c.stale,
+                        "claims_stale": live.stats.claims_stale,
+                    }
+                finally:
+                    live.claim_release(c)
+                out["stale"]["swept"] = live.claim_sweep()
+                out["stale"]["scan"] = live.claim_scan()
+            finally:
+                live.close()
+                holder.kill()
+                holder.wait()
+            # wake the zombie into its queued SIGTERM; it must exit. The
+            # supervisor may have already escalated and reaped it (its
+            # liveness probe kills a stopped worker) — also a clean exit.
+            try:
+                os.kill(zpid, signal.SIGCONT)
+            except ProcessLookupError:
+                out["zombie_exited"] = True
+            end = time.monotonic() + 30.0
+            while time.monotonic() < end:
+                try:
+                    os.kill(zpid, 0)
+                except ProcessLookupError:
+                    out["zombie_exited"] = True
+                    break
+                await asyncio.sleep(0.2)
+            for _ in range(20):
+                ok = await _lb_get(session, fleet.url(0), counts)
+                out["ok" if ok else "fail"] += 1
+    finally:
+        await fleet.stop()
+    out["counts"] = counts
+    return out
+
+
+def _ownership_zombie_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_ownership_zombie_soak(duration, concurrency))
+    f, s = got["fence"], got["stale"]
+    row = {
+        "metric": "chaos_ownership_zombie",
+        "replaced": got["replaced"],
+        "zombie_exited": got["zombie_exited"],
+        "fence": f,
+        "stale": s,
+        "post_recovery_ok": got["ok"],
+        "post_recovery_fail": got["fail"],
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if not got["replaced"]:
+        fails.append("SIGSTOPped owner was never replaced by the "
+                     "liveness probe")
+    if f.get("won") or f.get("busy") or f.get("fenced_claims") != 1:
+        fails.append(f"zombie identity was NOT refused at claim_acquire "
+                     f"({f})")
+    if s.get("won") or s.get("busy") or not s.get("stale") \
+            or s.get("claims_stale") != 1:
+        fails.append(f"deposed live holder not detected STALE ({s})")
+    if s.get("swept", 0) < 1 or (s.get("scan") or {}).get("live", 1) != 0:
+        fails.append(f"zombie-held claim not reclaimed by sweep ({s})")
+    if not got["zombie_exited"]:
+        fails.append("revived zombie never exited")
+    if got["fail"]:
+        fails.append(f"{got['fail']} post-recovery requests failed")
+    if fails:
+        for fl in fails:
+            print(f"[chaos] FAIL: {fl}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (ownership zombie): replaced at epoch "
+          f"{f.get('new_epoch')} (old {f.get('old_epoch')}), zombie claim "
+          "refused, deposed holder read stale and was swept, "
+          f"{got['ok']}/20 post-recovery ok", file=sys.stderr)
+    return 0, row
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -1642,7 +1953,24 @@ def main() -> int:
     except OSError as e:
         print(f"[chaos] WARN: could not archive lane counters: {e}",
               file=sys.stderr)
-    return rc_lanes
+    if rc_lanes:
+        return rc_lanes
+    # ROWS 11-12 (ISSUE 19): digest ownership under owner death — the
+    # SIGKILL-mid-coalesce storm and the SIGSTOP zombie claim fence
+    rc_own_kill, own_kill_row = _ownership_kill_row(duration, concurrency)
+    rc_own_zombie, own_zombie_row = _ownership_zombie_row(duration,
+                                                          concurrency)
+    try:
+        with open("artifacts/chaos_ownership.json", "w") as f:
+            json.dump({"ownership_kill": own_kill_row,
+                       "ownership_zombie": own_zombie_row}, f, indent=2,
+                      sort_keys=True)
+        print("[chaos] ownership counters archived to "
+              "artifacts/chaos_ownership.json", file=sys.stderr)
+    except OSError as e:
+        print(f"[chaos] WARN: could not archive ownership counters: {e}",
+              file=sys.stderr)
+    return rc_own_kill or rc_own_zombie
 
 
 if __name__ == "__main__":

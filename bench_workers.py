@@ -159,11 +159,15 @@ def _sum_fleet_counters(port: int, samples: int = 30) -> dict:
     out = {"workers_seen": len(per_pid)}
     for k in ("hits", "misses", "publishes", "corrupt", "corrupt_served"):
         out[k] = sum(v.get(k, 0) for v in per_pid.values())
+    for k in ("forwards", "serve_forwarded", "waiter_hits",
+              "local_fallbacks"):
+        out["coh_" + k] = sum(v.get("coherence", {}).get(k, 0)
+                              for v in per_pid.values())
     return out
 
 
 def _shm_arm(n: int, origin_base: str, seq: list, duration: float,
-             n_threads: int, shm_on: bool) -> dict:
+             n_threads: int, shm_on: bool, extra_args: tuple = ()) -> dict:
     port = free_port()
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", env.get("BENCH_PLATFORM", "cpu"))
@@ -180,6 +184,7 @@ def _shm_arm(n: int, origin_base: str, seq: list, duration: float,
         os.unlink(fleet_path)
         env["IMAGINARY_TPU_FLEET_PATH"] = fleet_path
         args += ["--fleet-cache-mb", "64"]
+        args += list(extra_args)
     else:
         env.pop("IMAGINARY_TPU_FLEET_PATH", None)
     sup = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
@@ -285,9 +290,212 @@ def shm_ab(duration: float, n_threads: int, n: int = 2) -> int:
     return 0
 
 
+# --- fleet coherence rows (ISSUE 19) -----------------------------------------
+
+_R19_ARTIFACT = os.path.join("artifacts", "bench_workers_r19_cpu.jsonl")
+
+COHERENCE_ARGS = ("--fleet-coherence", "--cache-coalesce",
+                  "--fleet-hop-ms", "15000")
+
+
+def _archive_r19(row: dict) -> None:
+    try:
+        os.makedirs("artifacts", exist_ok=True)
+        with open(_R19_ARTIFACT, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    except OSError as e:
+        print(f"[workers] WARN: could not archive to {_R19_ARTIFACT}: {e}",
+              file=sys.stderr)
+
+
+def fleet_coalesce_gate(n: int = 2, clients: int = 12) -> int:
+    """THE singleflight gate: a cold fleet takes `clients` CONCURRENT
+    IDENTICAL requests and must execute the pipeline exactly ONCE
+    fleet-wide — local coalescing collapses each worker's copies, the
+    forward hop routes every worker to the digest's owner, and the claim
+    table guarantees the owner runs once. Metered by the publish delta:
+    every execution deposits exactly one shm entry; waiters and
+    forwarded serves deposit nothing."""
+    base = make_1080p_jpeg()
+    variants = [base + b"\x00", base + b"\x00\x00"]
+    origin, origin_base = _start_origin(variants)
+    port = free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", env.get("BENCH_PLATFORM", "cpu"))
+    for k in ("IMAGINARY_TPU_WORKER", "IMAGINARY_TPU_WORKER_EPOCH"):
+        env.pop(k, None)
+    fd, fleet_path = tempfile.mkstemp(prefix="bench-fleet-", suffix=".shm")
+    os.close(fd)
+    os.unlink(fleet_path)
+    env["IMAGINARY_TPU_FLEET_PATH"] = fleet_path
+    args = [sys.executable, "-m", "imaginary_tpu.cli", "--workers", str(n),
+            "--port", str(port), "--enable-url-source",
+            "--cache-result-mb", "32", "--fleet-cache-mb", "64",
+            "--request-timeout", "60"] + list(COHERENCE_ARGS)
+    sup = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+    errs: list = []
+    try:
+        _wait_healthy(port)
+        # warm BOTH workers' compile ladders on the warm-only URL (the
+        # kernel spreads fresh connections; 3x clients reaches both)
+        warm_url = (f"http://127.0.0.1:{port}/resize?width=300&height=200"
+                    f"&url={origin_base}/img/0")
+        for _ in range(3 * clients):
+            req = urllib.request.Request(warm_url,
+                                         headers={"Connection": "close"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+        before = _sum_fleet_counters(port)
+        url = (f"http://127.0.0.1:{port}/resize?width=300&height=200"
+               f"&url={origin_base}/img/1")
+        barrier = threading.Barrier(clients)
+
+        def one():
+            try:
+                barrier.wait(timeout=60)
+                req = urllib.request.Request(url,
+                                             headers={"Connection": "close"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    if r.status != 200 or not r.read():
+                        errs.append("bad response")
+            except Exception as e:  # the gate reports, never hangs
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=one) for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        after = _sum_fleet_counters(port)
+    finally:
+        sup.send_signal(signal.SIGTERM)
+        try:
+            sup.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+            sup.wait()
+        origin.shutdown()
+        if os.path.exists(fleet_path):
+            try:
+                os.unlink(fleet_path)
+            except OSError:
+                pass
+    executed = after.get("publishes", 0) - before.get("publishes", 0)
+    row = {
+        "metric": "workers_fleet_coalesce",
+        "workers": n,
+        "clients": clients,
+        "executions": executed,
+        "errors": len(errs),
+        "coh_forwards": after.get("coh_forwards", 0),
+        "coh_serve_forwarded": after.get("coh_serve_forwarded", 0),
+        "coh_waiter_hits": after.get("coh_waiter_hits", 0),
+        "cpus": os.cpu_count() or 1,
+    }
+    print(json.dumps(row), flush=True)
+    _archive_r19(row)
+    fails = []
+    if errs:
+        fails.append(f"{len(errs)} of {clients} concurrent requests "
+                     f"failed: {errs[:3]}")
+    if executed != 1:
+        fails.append(f"{clients} identical concurrent requests executed "
+                     f"{executed} times fleet-wide (want exactly 1)")
+    if fails:
+        for f in fails:
+            print(f"[workers] FLEET COALESCE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[workers] FLEET COALESCE PASS: {clients} concurrent identical "
+          f"requests -> 1 execution fleet-wide at N={n}", file=sys.stderr)
+    return 0
+
+
+def coherence_ab(duration: float, n_threads: int, n: int = 2) -> int:
+    """Coherence on/off zipf A/B over the same shm-tiered fleet. The
+    claim: digest ownership turns cold cross-worker traffic into served
+    traffic — a non-owner's miss rides the hop to the owner instead of
+    recomputing. Cross-worker service ratio = (shm hits + forwarded
+    serves) / shm lookups; every forward follows a local shm miss, so
+    the ratio stays <= 1 and the OFF arm's forwards are zero by
+    construction."""
+    base = make_1080p_jpeg()
+    variants = [base + b"\x00" * (i + 1) for i in range(SHM_AB_URLS + 1)]
+    origin, origin_base = _start_origin(variants)
+    try:
+        seq = _zipf_seq(20_000, SHM_AB_URLS, SHM_AB_ZIPF)
+        arms = []
+        for coh_on in (False, True, True, False):  # ABBA: drift cancels
+            arms.append(_shm_arm(
+                n, origin_base, seq, duration, n_threads, shm_on=True,
+                extra_args=COHERENCE_ARGS if coh_on else ()))
+    finally:
+        origin.shutdown()
+    off_rate = (arms[0]["rate"] + arms[3]["rate"]) / 2.0
+    on_rate = (arms[1]["rate"] + arms[2]["rate"]) / 2.0
+    on_fleet = {k: arms[1]["fleet"].get(k, 0) + arms[2]["fleet"].get(k, 0)
+                for k in ("hits", "misses", "publishes", "corrupt_served",
+                          "coh_forwards", "coh_serve_forwarded",
+                          "coh_waiter_hits", "coh_local_fallbacks")}
+    # client-side lookups only: a forwarded request books a SECOND shm
+    # lookup on the owner while serving the hop (one client request, two
+    # processes), so the owner-side share — one lookup per forwarded
+    # serve — comes out of the denominator. The ratio reads: of the
+    # requests that missed their local LRU, what fraction the fleet
+    # served without a local recompute (shm hit or owner forward).
+    lookups = (on_fleet["hits"] + on_fleet["misses"]
+               - on_fleet["coh_serve_forwarded"])
+    cross = (on_fleet["hits"] + on_fleet["coh_forwards"]) / lookups \
+        if lookups > 0 else 0.0
+    ratio = round(on_rate / off_rate, 3) if off_rate else 0.0
+    row = {
+        "metric": "workers_coherence_ab",
+        "workers": n,
+        "unit": "req/sec",
+        "coherence_off": round(off_rate, 2),
+        "coherence_on": round(on_rate, 2),
+        "ratio": ratio,
+        "cross_worker_hit_ratio": round(cross, 4),
+        "shm_hits": on_fleet["hits"],
+        "forwards": on_fleet["coh_forwards"],
+        "serve_forwarded": on_fleet["coh_serve_forwarded"],
+        "waiter_hits": on_fleet["coh_waiter_hits"],
+        "local_fallbacks": on_fleet["coh_local_fallbacks"],
+        "corrupt_served": on_fleet["corrupt_served"],
+        "cpus": os.cpu_count() or 1,
+    }
+    print(json.dumps(row), flush=True)
+    _archive_r19(row)
+    fails = []
+    if off_rate == 0 or on_rate == 0:
+        fails.append("an arm produced zero requests")
+    if on_fleet["coh_forwards"] == 0:
+        fails.append("coherence arm never took the forward hop")
+    if cross <= 0.458:
+        fails.append(f"cross-worker hit ratio {cross:.4f} <= 0.458 with "
+                     "coherence on")
+    if on_fleet["corrupt_served"]:
+        fails.append("corrupt bytes served")
+    if fails:
+        for f in fails:
+            print(f"[workers] COHERENCE A/B FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"[workers] COHERENCE A/B PASS: {off_rate:.1f} -> {on_rate:.1f} "
+          f"req/s ({ratio}x) at N={n}, cross-worker hit ratio "
+          f"{cross:.4f} (> 0.458)", file=sys.stderr)
+    return 0
+
+
 def main() -> None:
     duration = float(os.environ.get("BENCH_DURATION", "12"))
     n_threads = int(os.environ.get("BENCH_THREADS", "16"))
+    if os.environ.get("BENCH_COHERENCE_ONLY", "0") == "1":
+        # the r19 gate subset: fleet singleflight + coherence A/B only
+        rc = fleet_coalesce_gate()
+        rc = coherence_ab(duration, n_threads) or rc
+        if rc:
+            raise SystemExit(rc)
+        return
     counts = [int(x) for x in os.environ.get("BENCH_WORKERS", "1 2").split()]
     body = make_1080p_jpeg()
     results = []
@@ -303,6 +511,11 @@ def main() -> None:
               f"on a {os.cpu_count()}-core host", file=sys.stderr)
     if os.environ.get("BENCH_SHM_AB", "1") != "0":
         if shm_ab(duration, n_threads) != 0:
+            raise SystemExit(1)
+    if os.environ.get("BENCH_COHERENCE", "1") != "0":
+        rc = fleet_coalesce_gate()
+        rc = coherence_ab(duration, n_threads) or rc
+        if rc:
             raise SystemExit(1)
 
 

@@ -337,6 +337,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "replacement reports ready and it stops "
                         "accepting, before SIGTERM starts its normal "
                         "shutdown drain")
+    p.add_argument("--fleet-coherence", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_FLEET_COHERENCE"),
+                   help="arm the fleet data plane's coherence layer: "
+                        "rendezvous digest ownership with a local IPC "
+                        "forward hop, fleet-wide singleflight via the "
+                        "shm claim table, and device-owner gating; "
+                        "requires --fleet-cache-mb > 0; every owner-"
+                        "path fault fails open to local execution")
+    p.add_argument("--fleet-hop-ms", type=float,
+                   default=_env_float("IMAGINARY_TPU_FLEET_HOP_MS", 250.0),
+                   help="forward-hop budget in ms a non-owner gives the "
+                        "digest owner (clamped by the request "
+                        "deadline's remaining budget) before failing "
+                        "open to local execution")
+    p.add_argument("--fleet-qos", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_FLEET_QOS"),
+                   help="enforce per-tenant GCRA rates and in-queue "
+                        "share caps fleet-wide via the shm qos table "
+                        "(closes the spray-across-workers rate-limit "
+                        "evasion); requires --fleet-cache-mb > 0; "
+                        "shared-table faults degrade to per-worker "
+                        "enforcement (fail-open)")
     p.add_argument("--fleet-admin-port", type=int,
                    default=_env_int("IMAGINARY_TPU_FLEET_ADMIN_PORT", 0),
                    help="supervisor admin plane on 127.0.0.1: /metrics "
@@ -609,6 +631,12 @@ def options_from_args(args) -> ServerOptions:
         raise SystemExit(f"mount directory does not exist: {args.mount}")
     if args.http_cache_ttl < -1 or args.http_cache_ttl > 31556926:
         raise SystemExit("The -http-cache-ttl flag only accepts a value from 0 to 31556926")
+    if (args.fleet_coherence or args.fleet_qos) and args.fleet_cache_mb <= 0:
+        # the coordination tables (claims, qos) ride the shm cache file;
+        # refusing at boot beats silently serving without coherence
+        raise SystemExit(
+            "--fleet-coherence/--fleet-qos require --fleet-cache-mb > 0 "
+            "(the ownership/claim/qos tables live in the shared cache file)")
     if args.qos_config:
         # validate at boot, like the placeholder/signature checks above:
         # a typo'd tenant table must refuse to start, not silently serve
@@ -674,6 +702,9 @@ def options_from_args(args) -> ServerOptions:
         workers=_resolve_workers(args.workers),
         fleet_cache_mb=max(0.0, args.fleet_cache_mb),
         fleet_roll_grace_s=max(0.0, args.fleet_roll_grace),
+        fleet_coherence=args.fleet_coherence,
+        fleet_hop_ms=max(1.0, args.fleet_hop_ms),
+        fleet_qos=args.fleet_qos,
         fleet_admin_port=max(0, args.fleet_admin_port),
         read_timeout_s=max(0.0, args.read_timeout),
         max_queue_ms=max(0.0, args.max_queue_ms),

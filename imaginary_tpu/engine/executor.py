@@ -262,6 +262,14 @@ class ExecutorConfig:
     # padding overhead for no per-chip win). 0 derives 2x the mesh
     # batch axis, i.e. every chip gets >= 2 items before sharding.
     shard_min_items: int = 0
+    # Fleet coherence (fleet/ownership.py): False on workers that do
+    # NOT own the chip group — the lane tier and mesh sharding stay off
+    # (mesh_policy forced "off") so the chip group's lanes + compiled
+    # mesh generations live in exactly ONE process; non-owners reach
+    # the chips over the forward hop or serve on the host backend.
+    # Owner death re-elects via the supervisor epoch bump, and the new
+    # owner pays the one mesh-generation recompile.
+    device_owner: bool = True
 
 
 @dataclasses.dataclass
@@ -513,6 +521,10 @@ class Executor:
         if self.config.host_spill is None:
             self.config = dataclasses.replace(self.config, host_spill=True)
         self._mesh_policy = (self.config.mesh_policy or "off").lower()
+        if not self.config.device_owner:
+            # a non-owner must not stand up lanes or mesh generations —
+            # the chip group's compiled state lives once, on the owner
+            self._mesh_policy = "off"
         if self.config.spatial_mpix > 0.0:
             # the lane tier's knob is in megapixels; it maps onto the
             # existing pixel threshold so both routes share one bar
@@ -536,8 +548,10 @@ class Executor:
         self._mesh_spatial = 1
         # mesh_policy supersedes use_mesh: the lane tier owns the mesh
         # when armed (use_mesh's single-collector sharding would fight
-        # the per-chip collectors for the same chips)
-        if self.config.use_mesh and self._mesh_policy == "off":
+        # the per-chip collectors for the same chips); a non-device-
+        # owner stands up no mesh sharding either
+        if self.config.use_mesh and self._mesh_policy == "off" \
+                and self.config.device_owner:
             from jax.sharding import NamedSharding, PartitionSpec
 
             from imaginary_tpu.parallel import batch_sharding, get_mesh

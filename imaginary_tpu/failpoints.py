@@ -69,6 +69,16 @@ Sites (each named for the subsystem boundary it sits on):
                    the worker behave as a DEPOSED zombie (publish
                    refused + fenced counter) without needing a real
                    supervisor replacement cycle
+  fleet.claim      the fleet-singleflight claim acquire
+                   (fleet/shmcache.py claim_acquire); keyable by worker
+                   index — an error() makes the acquire fail open to an
+                   uncoordinated local run, a delay() opens a SIGKILL
+                   window while siblings are mid-protocol
+  fleet.forward    the ownership forward hop, client side, before the
+                   dial (fleet/ownership.py); keyable by the OWNER's
+                   worker index — an error() forces the fail-open
+                   local fallback, a delay() burns the hop budget so
+                   the deadline-bounded timeout path runs for real
 
 Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
 
@@ -119,6 +129,8 @@ SITES = (
     "codec.bomb",
     "fleet.write",
     "worker.zombie",
+    "fleet.claim",
+    "fleet.forward",
 )
 
 # keyed-site spelling: site[key], key limited to a safe token charset

@@ -7,10 +7,16 @@ be able to corrupt what its siblings serve. The design earns that the
 same way PR 10 earned multi-chip: assume a process can die, lie, or lag
 at any byte boundary.
 
-Layout (one header page, then fixed-size slots):
+Layout (one header page, two coordination regions, then fixed-size
+slots; the magic is versioned so a binary with a different layout
+refuses to attach rather than misreading offsets):
 
     +--------------------------------------------------------------+
     | magic | nslots | slot_bytes | lru tick | worker epoch table  |
+    +--------------------------------------------------------------+
+    | claim table: in-flight (digest -> worker, epoch) claims      |
+    +--------------------------------------------------------------+
+    | qos table: per-tenant GCRA tat + per-worker in-flight shares |
     +--------------------------------------------------------------+
     | slot 0: state | epoch | tick | lens | key | checksum | data  |
     | slot 1: ...                                                  |
@@ -47,6 +53,25 @@ hung, replacement already stamped+spawned — that wakes up finds the
 table ahead of its own epoch: it MAY read (stale reads of sealed
 immutable entries are safe) but may NOT publish, which closes the
 zombie-writer race the spawn-first replacement policy opened in PR 6.
+
+Fleet singleflight (the claim table): an in-flight claim is the same
+two-phase, kernel-released-lock discipline applied to WORK instead of
+bytes. `claim_acquire` exclusive-locks the claim entry's byte, stamps
+(CLAIMED, worker, epoch, key), and HOLDS the lock for the whole
+pipeline execution; `claim_release` (always, in a `finally` — itpucheck
+ITPU013) clears the entry and drops the lock. A holder SIGKILLed
+mid-flight loses the lock to the kernel, so the next waiter's acquire
+attempt simply wins and re-dispatches. A SIGSTOP zombie keeps the lock
+but its stamped epoch no longer matches the supervisor table — waiters
+treat that claim as STALE and execute locally (bounded duplicate work,
+never a stall), and the zombie's own acquires are refused outright.
+
+Fleet QoS (the qos table): per-tenant GCRA theoretical-arrival-time and
+per-worker in-flight share columns, each entry under its own byte lock.
+Share columns are epoch-tagged so a SIGKILLed worker's leaked in-flight
+count stops being charged the moment its successor is stamped. Every
+operation here is fail-open: lock contention or table overflow returns
+None/True and the caller falls back to its process-local enforcement.
 """
 
 from __future__ import annotations
@@ -62,7 +87,7 @@ from typing import Optional
 
 from imaginary_tpu import failpoints
 
-MAGIC = b"ITPUFLT1"
+MAGIC = b"ITPUFLT2"  # v2: claim + qos regions between header and slots
 HEADER_BYTES = 4096  # one page: magic/geometry/tick + the epoch table
 MAX_WORKERS = 64
 SLOT_BYTES = 128 * 1024  # entries above ~128 KB stay local-tier-only
@@ -74,6 +99,25 @@ _OFF_NSLOTS = 8
 _OFF_SLOT_BYTES = 12
 _OFF_TICK = 16
 _OFF_EPOCHS = 24  # MAX_WORKERS x u64
+
+# claim table (fleet singleflight): [HEADER_BYTES, _QOS_OFF)
+CLAIM_SLOTS = 64
+_CLAIM_OFF = HEADER_BYTES
+_CLAIM_BYTES = 64
+_CLAIM_HDR = struct.Struct("<IIQ32s")  # state | worker | epoch | key
+CLAIM_FREE, CLAIMED = 0, 1
+
+# qos table (fleet-wide GCRA + in-flight shares): [_QOS_OFF, META_BYTES)
+QOS_TENANTS = 32
+_QOS_OFF = _CLAIM_OFF + CLAIM_SLOTS * _CLAIM_BYTES
+_QOS_ENTRY_BYTES = 320
+_QOS_HDR = struct.Struct("<8sd")  # tenant-name hash | GCRA tat (abs s)
+_QOS_SHARE_OFF = 16  # then MAX_WORKERS u32 share columns
+_QOS_PROBE = 4  # linear probe window before giving up (fail-open)
+
+# slots start here. The three fcntl byte-lock ranges — claim entries,
+# qos entries, slot first-bytes — are disjoint by construction.
+META_BYTES = _QOS_OFF + 12288
 
 # slot header: state u32 | epoch u64 | tick u64 | meta_len u32 |
 # body_len u32 | key 32s | checksum 16s
@@ -111,6 +155,18 @@ class FleetStats:
     # it 0 so any future bypass of verify-before-serve trips the gate.
     corrupt_served: int = 0
     evictions: int = 0
+    # fleet singleflight (the claim table): won = this process became
+    # the executor for a digest; busy = a LIVE sibling already held the
+    # claim (we waited or failed open); stale = the holder's epoch was
+    # deposed (SIGSTOP zombie) so we refused to honor its claim;
+    # reclaimed = we won a claim entry a DEAD holder left CLAIMED
+    # (the kernel freed its lock — the waiter re-dispatch path)
+    claims_won: int = 0
+    claims_busy: int = 0
+    claims_stale: int = 0
+    claims_reclaimed: int = 0
+    # claim acquires refused because THIS process is fenced (deposed)
+    fenced_claims: int = 0
     # bytes the hit path actually copied out of the mmap (the one
     # defensive snapshot per hit). The serving layer hands out views of
     # that snapshot, so bytes_copied / hit-bytes-served == 1.0 is the
@@ -131,6 +187,24 @@ class _Slot:
         self.idx = idx
         self.prev_state = prev_state
         self.published = False
+
+
+class FleetClaim:
+    """Result of a claim_acquire attempt. Exactly one of `won`/`busy`
+    may be set; neither set means execute locally without coordination
+    (fenced, stale holder, hash collision, injected fault — all the
+    fail-open outcomes). Always hand it back to claim_release in a
+    `finally`, whatever the outcome (ITPU013)."""
+
+    __slots__ = ("idx", "key", "won", "busy", "stale", "holder")
+
+    def __init__(self, idx: int, key: bytes):
+        self.idx = idx
+        self.key = key
+        self.won = False
+        self.busy = False  # a live sibling is executing this digest
+        self.stale = False  # a deposed zombie holds the entry
+        self.holder = -1
 
 
 def _checksum(key: bytes, epoch: int, meta: bytes, body: bytes) -> bytes:
@@ -166,9 +240,13 @@ class ShmCache:
         self.owner = owner
         self.stats = FleetStats()
         self._lock = threading.Lock()
+        # claim entries THIS process currently holds (idx -> key):
+        # fcntl locks don't exclude threads of one process, so sibling
+        # threads consult this before touching the kernel lock
+        self._owned_claims: dict = {}
         if create:
             nslots = max(8, int(size_mb * 1e6) // SLOT_BYTES)
-            total = HEADER_BYTES + nslots * SLOT_BYTES
+            total = META_BYTES + nslots * SLOT_BYTES
             fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
             try:
                 os.ftruncate(fd, total)
@@ -249,26 +327,31 @@ class ShmCache:
     # -- locks -----------------------------------------------------------
 
     def _slot_off(self, idx: int) -> int:
-        return HEADER_BYTES + idx * SLOT_BYTES
+        return META_BYTES + idx * SLOT_BYTES
 
-    def _try_lock(self, idx: int, exclusive: bool) -> bool:
+    def _try_lock_off(self, off: int, exclusive: bool = True) -> bool:
         import fcntl
 
         kind = fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH
         try:
-            fcntl.lockf(self._fd, kind | fcntl.LOCK_NB, 1,
-                        self._slot_off(idx))
+            fcntl.lockf(self._fd, kind | fcntl.LOCK_NB, 1, off)
             return True
         except OSError:
             return False
 
-    def _unlock(self, idx: int) -> None:
+    def _unlock_off(self, off: int) -> None:
         import fcntl
 
         try:
-            fcntl.lockf(self._fd, fcntl.LOCK_UN, 1, self._slot_off(idx))
+            fcntl.lockf(self._fd, fcntl.LOCK_UN, 1, off)
         except OSError:  # itpu: allow[ITPU004] unlock of a lock lost to fd teardown; kernel already released it
             pass
+
+    def _try_lock(self, idx: int, exclusive: bool) -> bool:
+        return self._try_lock_off(self._slot_off(idx), exclusive)
+
+    def _unlock(self, idx: int) -> None:
+        self._unlock_off(self._slot_off(idx))
 
     # -- header ----------------------------------------------------------
 
@@ -293,6 +376,319 @@ class ShmCache:
         """True when a successor for this worker index has been stamped:
         this process may read but must not publish."""
         return self.epoch_of(self.worker) != self.epoch
+
+    def live_workers(self) -> list:
+        """(idx, epoch) for every stamped worker — the ownership ring's
+        membership view. Empty in standalone epoch-0 mode ONLY when
+        nothing was ever stamped non-zero; a standalone creator stamps
+        itself, so its own table reads all-zero and the ring is empty
+        (coherence degrades to plain local execution, which is parity)."""
+        out = []
+        for i in range(MAX_WORKERS):
+            e = self.epoch_of(i)
+            if e != 0:
+                out.append((i, e))
+        return out
+
+    # -- claim table (fleet singleflight, the ITPU013 protocol) ----------
+
+    def _claim_off(self, idx: int) -> int:
+        return _CLAIM_OFF + idx * _CLAIM_BYTES
+
+    def claim_index(self, key: bytes) -> int:
+        # bytes [8:16) so the claim entry decorrelates from the slot
+        # candidate window (which maps by bytes [0:8) of the same key)
+        return int.from_bytes(key[8:16], "little") % CLAIM_SLOTS
+
+    def _claim_hdr(self, idx: int) -> tuple:
+        return _CLAIM_HDR.unpack_from(self._mm, self._claim_off(idx))
+
+    def claim_acquire(self, key: bytes) -> FleetClaim:
+        """Try to become the fleet-wide executor for `key`. The winner's
+        exclusive byte lock is HELD until claim_release — holder death
+        releases it in the kernel, which is how waiters detect it. Every
+        outcome (won / busy / neither) must flow through claim_release
+        in a `finally` (ITPU013)."""
+        idx = self.claim_index(key)
+        c = FleetClaim(idx, key)
+        try:
+            # chaos: error() = injected claim fault (caller fails open
+            # to an uncoordinated local run); delay() = a SIGKILL window
+            # while siblings are mid-protocol
+            failpoints.hit("fleet.claim", key=self.worker)
+        except failpoints.FailpointError:
+            return c
+        if self.fenced():
+            # a deposed zombie must never become an executor its
+            # successor's waiters would wait on
+            self.stats.fenced_claims += 1
+            return c
+        with self._lock:
+            held = self._owned_claims.get(idx)
+            if held is not None:
+                # a sibling THREAD of this process holds the entry:
+                # same key = genuinely in flight here; different key =
+                # hash collision, run locally without coordination
+                if held == key:
+                    c.busy = True
+                    c.holder = self.worker
+                    self.stats.claims_busy += 1
+                return c
+            if not self._try_lock_off(self._claim_off(idx)):
+                state, w, e, k = self._claim_hdr(idx)
+                if state == CLAIMED and k == key:
+                    if self.epoch_of(w) == e:
+                        c.busy = True
+                        c.holder = w
+                        self.stats.claims_busy += 1
+                    else:
+                        # lock held but epoch deposed: a SIGSTOP zombie.
+                        # Refuse to wait on it — execute locally (a
+                        # bounded duplicate beats an unbounded stall).
+                        c.stale = True
+                        self.stats.claims_stale += 1
+                # different key / not CLAIMED: collision or a race that
+                # just resolved — fail open, run locally
+                return c
+            # lock won. A CLAIMED entry under a freshly-won lock can
+            # only mean its holder DIED mid-flight (the kernel freed the
+            # lock) — we inherit the claim and re-dispatch the work.
+            state = struct.unpack_from(
+                "<I", self._mm, self._claim_off(idx))[0]
+            if state == CLAIMED:
+                self.stats.claims_reclaimed += 1
+            _CLAIM_HDR.pack_into(self._mm, self._claim_off(idx),
+                                 CLAIMED, self.worker, self.epoch, key)
+            self._owned_claims[idx] = key
+            c.won = True
+            self.stats.claims_won += 1
+            return c
+
+    def claim_release(self, claim: Optional[FleetClaim]) -> None:
+        """Always runs (finally): a won claim is cleared and its lock
+        dropped — only holder DEATH may skip this, and the kernel covers
+        that case. Non-won outcomes are no-ops, so every acquire can be
+        released unconditionally."""
+        if claim is None or not claim.won:
+            return
+        with self._lock:
+            _CLAIM_HDR.pack_into(self._mm, self._claim_off(claim.idx),
+                                 CLAIM_FREE, 0, 0, b"\0" * 32)
+            self._unlock_off(self._claim_off(claim.idx))
+            self._owned_claims.pop(claim.idx, None)
+            claim.won = False
+
+    def claim_abandon(self, claim: Optional[FleetClaim]) -> None:
+        """Alias of claim_release for call sites where the work was NOT
+        completed (error paths) — same protocol, clearer intent."""
+        self.claim_release(claim)
+
+    def claim_scan(self) -> dict:
+        """Claim-table ground truth: free / held-by-a-live-worker /
+        dead (CLAIMED but the holder's lock is gone or its epoch is
+        deposed). The chaos harness pins live == 0 at rest."""
+        counts = {"free": 0, "live": 0, "dead": 0}
+        with self._lock:
+            for idx in range(CLAIM_SLOTS):
+                state, w, e, _k = self._claim_hdr(idx)
+                if state != CLAIMED:
+                    counts["free"] += 1
+                elif idx in self._owned_claims:
+                    counts["live"] += 1
+                elif self.epoch_of(w) != e:
+                    counts["dead"] += 1
+                elif self._try_lock_off(self._claim_off(idx)):
+                    # lock winnable = the holder died without releasing
+                    self._unlock_off(self._claim_off(idx))
+                    counts["dead"] += 1
+                else:
+                    counts["live"] += 1
+        return counts
+
+    def claim_sweep(self) -> int:
+        """Reclaim claim entries whose holder is dead or deposed (lock
+        winnable, or epoch fenced). Waiters already reclaim these
+        opportunistically on their next acquire; this full scan is for
+        the maintenance ticker and the chaos harness's at-rest check."""
+        reclaimed = 0
+        with self._lock:
+            for idx in range(CLAIM_SLOTS):
+                state, w, e, _k = self._claim_hdr(idx)
+                if state != CLAIMED or idx in self._owned_claims:
+                    continue
+                if not self._try_lock_off(self._claim_off(idx)):
+                    if self.epoch_of(w) == e:
+                        continue  # a live holder mid-flight; not ours
+                    # deposed zombie still holding the kernel lock: the
+                    # entry is unhonored either way; clear the state so
+                    # the table reads at-rest (the lock dies with it)
+                    _CLAIM_HDR.pack_into(
+                        self._mm, self._claim_off(idx),
+                        CLAIM_FREE, 0, 0, b"\0" * 32)
+                    reclaimed += 1
+                    continue
+                try:
+                    if struct.unpack_from(
+                            "<I", self._mm, self._claim_off(idx))[0] \
+                            == CLAIMED:
+                        _CLAIM_HDR.pack_into(
+                            self._mm, self._claim_off(idx),
+                            CLAIM_FREE, 0, 0, b"\0" * 32)
+                        reclaimed += 1
+                finally:
+                    self._unlock_off(self._claim_off(idx))
+        return reclaimed
+
+    # -- qos table (fleet-wide GCRA + in-flight shares) -------------------
+
+    @staticmethod
+    def qos_hash(tenant: str) -> bytes:
+        return hashlib.blake2b(tenant.encode("utf-8"),
+                               digest_size=8).digest()
+
+    def _qos_entry_off(self, idx: int) -> int:
+        return _QOS_OFF + (idx % QOS_TENANTS) * _QOS_ENTRY_BYTES
+
+    def _qos_slot(self, h8: bytes) -> int:
+        """Entry index for a tenant hash, claiming a zero entry on first
+        use; -1 when the probe window is exhausted — the caller falls
+        back to process-local enforcement (fail-open, never a stall)."""
+        base = int.from_bytes(h8, "little") % QOS_TENANTS
+        for j in range(min(_QOS_PROBE, QOS_TENANTS)):
+            idx = (base + j) % QOS_TENANTS
+            off = self._qos_entry_off(idx)
+            cur = bytes(self._mm[off:off + 8])
+            if cur == h8:
+                return idx
+            if cur != b"\0" * 8:
+                continue
+            if not self._try_lock_off(off):
+                continue
+            try:
+                cur = bytes(self._mm[off:off + 8])
+                if cur == b"\0" * 8:
+                    self._mm[off:off + 8] = h8
+                    return idx
+                if cur == h8:
+                    return idx
+            finally:
+                self._unlock_off(off)
+        return -1
+
+    def qos_gcra_allow(self, tenant: str, emission: float, tau: float,
+                       now: float) -> Optional[tuple]:
+        """Fleet-wide GCRA decision against the SHARED theoretical
+        arrival time — same algorithm as the process-local
+        GCRARateLimiter, state moved into the mmap so a tenant spraying
+        connections across SO_REUSEPORT workers meets one budget, not N.
+        Returns (allowed, retry_after) or None when the shared entry is
+        unavailable (table overflow, or a peer holds the entry lock —
+        holders never sleep, so contention is ns-scale, but a SIGSTOPped
+        peer must not stall admission). `now` is wall clock (time.time,
+        the one clock local workers share); injectable for tests."""
+        idx = self._qos_slot(self.qos_hash(tenant))
+        if idx < 0:
+            return None
+        off = self._qos_entry_off(idx)
+        with self._lock:
+            for _ in range(3):
+                if self._try_lock_off(off):
+                    break
+            else:
+                return None
+            try:
+                _h, tat = _QOS_HDR.unpack_from(self._mm, off)
+                tat = max(tat, now)
+                if tat - now > tau:
+                    return False, tat - tau - now
+                _QOS_HDR.pack_into(self._mm, off,
+                                   self.qos_hash(tenant), tat + emission)
+                return True, 0.0
+            finally:
+                self._unlock_off(off)
+
+    def qos_share_charge(self, tenant: str, cap: int) -> Optional[bool]:
+        """Charge one unit of fleet-wide in-flight share for `tenant`.
+        True = charged (fleet total was below cap), False = fleet over
+        cap (the caller sheds exactly as it would for its local cap),
+        None = shared entry unavailable (fail open to local-only caps).
+        Each worker owns one column tagged with its epoch's low 16 bits:
+        a SIGKILLed worker's leaked count stops being summed the moment
+        the supervisor stamps its successor's epoch."""
+        idx = self._qos_slot(self.qos_hash(tenant))
+        if idx < 0:
+            return None
+        off = self._qos_entry_off(idx)
+        mytag = self.epoch & 0xffff
+        with self._lock:
+            for _ in range(3):
+                if self._try_lock_off(off):
+                    break
+            else:
+                return None
+            try:
+                col_off = off + _QOS_SHARE_OFF + self.worker * 4
+                (own,) = struct.unpack_from("<I", self._mm, col_off)
+                own_cnt = own & 0xffff if (own >> 16) == mytag else 0
+                total = own_cnt
+                for w in range(MAX_WORKERS):
+                    if w == self.worker:
+                        continue
+                    (col,) = struct.unpack_from(
+                        "<I", self._mm, off + _QOS_SHARE_OFF + w * 4)
+                    if col == 0:
+                        continue
+                    if (col >> 16) == (self.epoch_of(w) & 0xffff):
+                        total += col & 0xffff
+                if total >= cap:
+                    return False
+                struct.pack_into("<I", self._mm, col_off,
+                                 (mytag << 16) | min(own_cnt + 1, 0xffff))
+                return True
+            finally:
+                self._unlock_off(off)
+
+    def qos_share_release(self, tenant: str) -> None:
+        """Decrement this worker's column. Best-effort: if the entry
+        lock is contended past the retry budget the unit leaks until
+        this worker's next charge observes its own column (same tag)
+        or its epoch is re-stamped — never a stall on the release path."""
+        idx = self._qos_slot(self.qos_hash(tenant))
+        if idx < 0:
+            return
+        off = self._qos_entry_off(idx)
+        mytag = self.epoch & 0xffff
+        with self._lock:
+            for _ in range(8):
+                if self._try_lock_off(off):
+                    break
+            else:
+                return
+            try:
+                col_off = off + _QOS_SHARE_OFF + self.worker * 4
+                (own,) = struct.unpack_from("<I", self._mm, col_off)
+                if (own >> 16) != mytag:
+                    return
+                cnt = own & 0xffff
+                struct.pack_into(
+                    "<I", self._mm, col_off,
+                    (mytag << 16) | (cnt - 1) if cnt > 1 else 0)
+            finally:
+                self._unlock_off(off)
+
+    def qos_share_total(self, tenant: str) -> int:
+        """Fleet-wide in-flight units for `tenant` (live columns only)."""
+        idx = self._qos_slot(self.qos_hash(tenant))
+        if idx < 0:
+            return 0
+        off = self._qos_entry_off(idx)
+        total = 0
+        for w in range(MAX_WORKERS):
+            (col,) = struct.unpack_from(
+                "<I", self._mm, off + _QOS_SHARE_OFF + w * 4)
+            if col != 0 and (col >> 16) == (self.epoch_of(w) & 0xffff):
+                total += col & 0xffff
+        return total
 
     # -- slot primitives (the ITPU009 protocol) --------------------------
 
@@ -382,6 +778,17 @@ class ShmCache:
                 return meta, body
             self.stats.misses += 1
             return None
+
+    def sealed_peek(self, key: bytes) -> bool:
+        """Lock-free, stat-free probe: is a SEALED entry for `key`
+        visible right now? Claim waiters poll THIS instead of get() so
+        waiting never books misses; a True is always confirmed by a
+        real checksum-verified get() before any byte is served."""
+        for idx in self._candidates(key):
+            state, _e, _t, _ml, _bl, skey, _c = self._slot_hdr(idx)
+            if state == SEALED and skey == key:
+                return True
+        return False
 
     def put(self, key: bytes, meta: bytes, body: bytes) -> bool:
         """Two-phase deposit; best-effort (False = not cached, never an
@@ -511,6 +918,7 @@ class ShmCache:
         }
         out.update(self.slot_scan())
         out.update(self.stats.to_dict())
+        out["claims"] = self.claim_scan()
         return out
 
     def debug_snapshot(self) -> dict:

@@ -13,6 +13,14 @@ A tenant with no rate of its own inherits the global limit; when neither
 exists the tenant is unlimited and the call is free of limiter state
 entirely (no key is minted — an unlimited anonymous flood must not churn
 the tat store other tenants' throttle state lives in).
+
+Fleet coherence (--fleet-qos): when fleet/ownership.py registered a
+FleetQos handle, the GCRA decision runs against the SHARED tat in the
+shm qos table first — a hog tenant spraying M connections across N
+SO_REUSEPORT workers meets one budget instead of N. Any shared-table
+fault (contention, overflow, a torn fleet) answers None and the call
+falls through to the process-local store — fail-open: coherence can
+degrade admission back to per-worker limits, never block it.
 """
 
 from __future__ import annotations
@@ -41,5 +49,14 @@ class TenantLimiter:
             return True, 0.0  # unlimited: no key minted, no state touched
         burst = tenant.burst if tenant.burst >= 0 else self._global_burst
         emission = 1.0 / rate
+        tau = emission * max(burst, 0)
+        from imaginary_tpu.fleet import ownership
+
+        fq = ownership.fleet_qos()
+        if fq is not None:
+            got = fq.gcra_allow(tenant.name, emission, tau)
+            if got is not None:
+                return got
+            # shared table unavailable: fall through to the local store
         return self._gcra.allow("tenant:" + tenant.name, emission=emission,
-                                tau=emission * max(burst, 0))
+                                tau=tau)

@@ -8,6 +8,7 @@ Each rule module exposes:
 
 from imaginary_tpu.tools.rules import (
     async_blocking,
+    claim_protocol,
     config_surface,
     context_propagation,
     failpoint_registry,
@@ -32,6 +33,7 @@ RULES = (
     metrics_exposition,
     context_propagation,
     slot_protocol,
+    claim_protocol,
     obs_registry,
     label_cardinality,
 )

@@ -109,6 +109,9 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
         from imaginary_tpu.obs import looplag
 
         app["_looplag_task"] = looplag.start()
+        # fleet forward-hop server (fleet/ipc.py): bound here because it
+        # needs the running loop; no-op unless --fleet-coherence armed
+        await service.start_coherence()
 
     async def on_cleanup(app):
         from imaginary_tpu.obs import looplag
@@ -408,6 +411,10 @@ async def serve(o: ServerOptions, mrelease: int = 30) -> None:
                     # mid-deposit (writers also reclaim on collision;
                     # this bounds how long a torn slot can sit)
                     shm.sweep()
+                    # claim-table sweeper: clear entries whose holder
+                    # died (fcntl lock freed by the kernel) or was
+                    # epoch-deposed (a SIGSTOP zombie's stale claim)
+                    shm.claim_sweep()
 
         ticker = asyncio.create_task(memory_release()) if mrelease > 0 else None
         scheme = "https" if o.cert_file and o.key_file else "http"

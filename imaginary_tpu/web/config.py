@@ -135,6 +135,25 @@ class ServerOptions:
     # (SIGUSR1) and gets this long to finish in-flight work before
     # SIGTERM starts its normal shutdown drain.
     fleet_roll_grace_s: float = 5.0
+    # Fleet coherence (fleet/ownership.py + fleet/ipc.py): rendezvous
+    # digest ownership with a local IPC forward hop, fleet-wide
+    # singleflight via the shm claim table, and device-owner gating of
+    # the chip group. Requires --fleet-cache-mb > 0 (the coordination
+    # tables ride the shm file). False = OFF (parity: no ring, no
+    # sockets, no claim traffic — responses byte-identical to the
+    # incoherent build). Every owner-path fault fails OPEN to local
+    # execution.
+    fleet_coherence: bool = False
+    # Forward-hop budget in ms: a non-owner gives the owner at most
+    # this long (further clamped by the request deadline's remaining
+    # budget) before failing open to local execution.
+    fleet_hop_ms: float = 250.0
+    # Fleet-wide QoS enforcement: per-tenant GCRA tat + in-flight share
+    # columns in the shm qos table, so qos/limiter.py rates and
+    # sched.py share caps hold across every worker a tenant sprays
+    # connections over. Requires --fleet-cache-mb > 0. False = OFF
+    # (parity: per-process enforcement exactly as before).
+    fleet_qos: bool = False
     # Ingress slow-client hardening: close a connection whose request
     # read (headers or body) goes this many seconds without a byte —
     # the slowloris shape that would otherwise pin a worker slot

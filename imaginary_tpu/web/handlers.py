@@ -126,6 +126,11 @@ class ImageService:
         # LRU + ETag, singleflight coalescing, decoded-frame LRU, and the
         # remote-source TTL cache the registry consumes. All default off.
         self.caches = cache_mod.CacheSet.from_options(o)
+        # fleet coherence plane (fleet/ownership.py): None unless BOTH
+        # --fleet-cache-mb and --fleet-coherence armed — parity off
+        self.coherence = None
+        self._forward_server = None
+        self._armed_fleet_qos = False
         if o.fleet_cache_mb > 0:
             # fleet shm tier (fleet/shmcache.py): under a supervisor the
             # file was created before this worker spawned and rides in
@@ -137,6 +142,21 @@ class ImageService:
 
             self.caches.attach_shm(ShmCache.from_options(
                 o, worker=worker_index(), epoch=worker_epoch()))
+            if o.fleet_coherence and self.caches.shm is not None:
+                from imaginary_tpu.fleet.ownership import FleetCoherence
+
+                self.coherence = FleetCoherence(
+                    self.caches.shm, worker=worker_index(),
+                    hop_s=o.fleet_hop_ms / 1000.0)
+            if o.fleet_qos and self.caches.shm is not None:
+                # register the shared GCRA/share handle the qos layer
+                # consults lazily (fleet/ownership.py registry); cleared
+                # in close() so per-test apps never leak it
+                from imaginary_tpu.fleet import ownership as ownership_mod
+
+                ownership_mod.set_fleet_qos(
+                    ownership_mod.FleetQos(self.caches.shm))
+                self._armed_fleet_qos = True
         self.frame_cache = cache_mod.FrameCache(self.caches.frames,
                                                 self.caches.stats)
         self.registry = SourceRegistry(o, caches=self.caches)
@@ -166,7 +186,13 @@ class ImageService:
         host_exec_mod.set_dct_spill(o.host_dct_spill)
         from imaginary_tpu.ops import chain as dev_chain_mod
 
-        if o.cache_device_mb > 0:
+        # with coherence armed, the device frame cache (device-resident
+        # HBM state) lives ONLY on the device-owner worker — siblings run
+        # host-path and forward device-shaped digests to the owner, so N
+        # workers do not pin N copies of the hot frame set in HBM
+        is_dev_owner = (self.coherence is None
+                        or self.coherence.is_device_owner())
+        if o.cache_device_mb > 0 and is_dev_owner:
             dev_chain_mod.set_device_frame_cache(
                 cache_mod.DeviceFrameCache(self.caches.device,
                                            self.caches.stats))
@@ -220,6 +246,7 @@ class ImageService:
                 failslow_ratio=o.failslow_ratio,
                 failslow_min_samples=o.failslow_min_samples,
                 failslow_share=o.failslow_share,
+                device_owner=is_dev_owner,
             )
         )
         from imaginary_tpu.engine.executor import _available_cpus
@@ -257,11 +284,46 @@ class ImageService:
         return host_wait + self.executor.estimated_wait_ms()
 
     async def close(self):
+        await self.stop_coherence()
+        if self._armed_fleet_qos:
+            # unregister OUR handle only (tests boot many apps per
+            # process; a stale handle would point at a closed mmap)
+            from imaginary_tpu.fleet import ownership as ownership_mod
+
+            ownership_mod.set_fleet_qos(None)
+            self._armed_fleet_qos = False
         await self.registry.close()
         self.executor.shutdown()
         self.pool.shutdown(wait=False)
         if self.caches.shm is not None:
             self.caches.shm.close()
+
+    # -- fleet coherence: the forward-hop server lifecycle ---------------------
+
+    async def start_coherence(self) -> None:
+        """Bind this worker's forward socket (fleet/ipc.py). Called from
+        the app's on_startup hook — the server needs the running loop a
+        constructor does not have. No-op with coherence off. A bind
+        failure degrades to client-side-only coherence: this worker
+        still forwards OUT and claims; siblings forwarding HERE fail
+        open to their local execution (the subsystem's one answer)."""
+        if self.coherence is None or self._forward_server is not None:
+            return
+        from imaginary_tpu.fleet import ipc as ipc_mod
+
+        srv = ipc_mod.ForwardServer(
+            ipc_mod.socket_path(self.caches.shm.path, self.coherence.worker),
+            self._handle_forward)
+        try:
+            await srv.start()
+        except OSError:
+            return
+        self._forward_server = srv
+
+    async def stop_coherence(self) -> None:
+        if self._forward_server is not None:
+            await self._forward_server.stop()
+            self._forward_server = None
 
     # -- the image route handler ----------------------------------------------
 
@@ -543,46 +605,57 @@ class ImageService:
             if tr is not None:
                 tr.annotate(cache="result_miss")
 
+        # --- fleet coherence: forward to the digest's owner ----------------
+        # Armed only with --fleet-coherence: the rendezvous ring elects one
+        # owner per shared key; a non-owner ships source bytes + resolved
+        # params one local hop and serves the owner's answer (the owner's
+        # caches see every occurrence of the digest fleet-wide). Any hop
+        # fault falls through to the uncoordinated local path below.
+        flc = self.coherence
+        skey = None
+        if flc is not None and key is not None:
+            skey = cache_mod.shared_key(key)
+            fwd_query = dict(request.query)
+            if fwd_query.get("type") == "auto":
+                # ship the NEGOTIATED type: both sides must derive the
+                # same key, and the owner has no Accept header to re-run
+                # the negotiation against
+                fwd_query["type"] = opts.type
+            fwd = await flc.try_forward(op_name, fwd_query, buf, skey)
+            if fwd is not None:
+                out, placement = fwd
+                if caches.result.enabled:
+                    # promote: the next local occurrence skips the hop
+                    caches.result.put(key, (out, placement), len(out.body))
+                if tr is not None:
+                    tr.annotate(cache="fleet_forward", placement=placement)
+                return self._build_response(out, placement, vary, etag, o)
+
         async def produce():
             wm_rgba = await self._prefetch_watermark(request, op_name, opts)
-            # Inflight is incremented HERE and normally decremented inside
-            # _process_sync's own finally, in the pool thread — NOT in an
-            # async finally: a client disconnect cancels this coroutine
-            # while the worker thread keeps running, and decrementing on
-            # cancellation would collapse the backlog signal to ~0 exactly
-            # at overload (mass client timeouts), failing the admission
-            # gate open when it matters most. The one case _process_sync's
-            # finally can never cover: a task cancelled while still QUEUED
-            # in the pool never starts, so the done-callback balances the
-            # ledger for exactly the fut.cancelled() outcome
-            # (run_in_executor can't express this — its asyncio future
-            # abandons the pool task without cancelling it; submit +
-            # wrap_future propagates the cancellation into the pool
-            # queue). Without it every cancelled-while-queued request
-            # leaked one _inflight forever, inflating estimated_queue_ms
-            # until --max-queue-ms latched shut.
-            with self._inflight_lock:
-                self._inflight += 1
-            # copy_context() carries the contextvar trace into the worker
-            # thread: stage timings recorded there (decode/encode/
-            # host_spill via engine/timing.py) attribute to THIS request.
-            # For a coalesced group the leader's context rides along —
-            # the shared run's spans land in the leader's trace.
-            ctx = contextvars.copy_context()
-            fut = self.pool.submit(ctx.run, self._process_sync, op_name, buf,
-                                   opts, wm_rgba, meta, digest)
-            fut.add_done_callback(self._release_if_cancelled)
-            return await asyncio.wrap_future(fut)
+            return await self._submit_pool(op_name, buf, opts, wm_rgba,
+                                           meta, digest)
 
         async def run_work():
+            body_fn = produce
+            if flc is not None and key is not None:
+                # fleet singleflight: the local leader claims the shared
+                # key so N WORKERS x same digest still run the pipeline
+                # once fleet-wide; the claim runner owns the shm deposit
+                # (winner stores BEFORE the claim drops) and every
+                # failure exit runs locally — fail-open
+                async def claimed():
+                    return await flc.run_claimed(key, skey, produce, caches)
+
+                body_fn = claimed
             if caches.coalesce and key is not None:
                 # singleflight: N concurrent identical (digest, plan)
                 # requests run produce() ONCE — one _inflight unit, one
                 # pipeline run — and every waiter (shielded, so a client
                 # disconnect detaches without cancelling the group) gets
                 # the same result or the same error
-                return await caches.flight.run(key, produce)
-            return await produce()
+                return await caches.flight.run(key, body_fn)
+            return await body_fn()
 
         dl = deadline_mod.current()
         try:
@@ -615,11 +688,129 @@ class ImageService:
             # placement rides along so a replayed response carries the
             # same X-Imaginary-Backend facts as the run that produced it
             caches.result.put(key, (out, placement), len(out.body))
-        if key is not None:
+        if key is not None and flc is None:
             # fleet deposit (no-op when the shm tier is off): two-phase
-            # write-then-publish, refused when this worker is fenced
+            # write-then-publish, refused when this worker is fenced.
+            # With coherence armed the claim runner already deposited
+            # (winner stores before its claim drops) — a second store
+            # here would double-publish every miss.
             caches.shm_store(key, out, placement)
         return self._build_response(out, placement, vary, etag, o)
+
+    async def _submit_pool(self, op_name, buf, opts, wm_rgba, meta, digest):
+        """Dispatch one pipeline run onto the host pool. Inflight is
+        incremented HERE and normally decremented inside _process_sync's
+        own finally, in the pool thread — NOT in an async finally: a
+        client disconnect cancels the awaiting coroutine while the
+        worker thread keeps running, and decrementing on cancellation
+        would collapse the backlog signal to ~0 exactly at overload
+        (mass client timeouts), failing the admission gate open when it
+        matters most. The one case _process_sync's finally can never
+        cover: a task cancelled while still QUEUED in the pool never
+        starts, so the done-callback balances the ledger for exactly the
+        fut.cancelled() outcome (run_in_executor can't express this —
+        its asyncio future abandons the pool task without cancelling it;
+        submit + wrap_future propagates the cancellation into the pool
+        queue). Without it every cancelled-while-queued request leaked
+        one _inflight forever, inflating estimated_queue_ms until
+        --max-queue-ms latched shut."""
+        with self._inflight_lock:
+            self._inflight += 1
+        # copy_context() carries the contextvar trace into the worker
+        # thread: stage timings recorded there (decode/encode/
+        # host_spill via engine/timing.py) attribute to THIS request.
+        # For a coalesced group the leader's context rides along —
+        # the shared run's spans land in the leader's trace.
+        ctx = contextvars.copy_context()
+        fut = self.pool.submit(ctx.run, self._process_sync, op_name, buf,
+                               opts, wm_rgba, meta, digest)
+        fut.add_done_callback(self._release_if_cancelled)
+        return await asyncio.wrap_future(fut)
+
+    async def _handle_forward(self, header: dict, body: bytes):
+        """Owner side of the forward hop (fleet/ipc.py handler): compute
+        — or serve from this worker's caches — a sibling's request for a
+        digest this worker owns. The client already ran ingress checks
+        (size cap, signature, admission) and Accept negotiation; the
+        header carries the RESOLVED params, so keys derive identically
+        on both sides. Runs under a non-exported trace holding the
+        remaining hop budget as its deadline, so the pool/device waits
+        inherit the client's clock."""
+        flc = self.coherence
+        shm = self.caches.shm
+        if flc is None or shm is None or shm.fenced():
+            # a deposed zombie must not compute for the fleet: refuse in
+            # an orderly frame; the client falls back to local execution
+            if flc is not None:
+                flc.stats.serve_refused += 1
+            return {"status": "fenced"}, b""
+        op_name = str(header.get("op", ""))
+        try:
+            opts = build_params_from_query(
+                {str(k): str(v) for k, v in dict(header.get("query")
+                                                 or {}).items()})
+        except ParamError:
+            return {"status": "error", "error": "params"}, b""
+        sniffed = determine_image_type(body)
+        if sniffed is ImageType.UNKNOWN:
+            return {"status": "error", "error": "media"}, b""
+        caches = self.caches
+        digest = cache_mod.source_digest(body)
+        key = cache_mod.request_key(digest, op_name, opts) \
+            if caches.keyed else None
+        tr = obs_trace.RequestTrace(request_id="fleet-forward", enabled=False)
+        budget_ms = float(header.get("budget_ms") or 0)
+        if budget_ms > 0:
+            tr.deadline = deadline_mod.Deadline(budget_ms / 1000.0)
+        token = obs_trace.activate(tr)
+        try:
+            if key is not None:
+                if caches.result.enabled:
+                    try:
+                        hit = caches.result.get(key)
+                    except Exception:
+                        hit = None
+                    if hit is not None:
+                        caches.stats.result_hits += 1
+                        out, placement = hit
+                        flc.stats.serve_forwarded += 1
+                        return ({"status": "ok", "mime": out.mime,
+                                 "placement": placement or ""},
+                                bytes(out.body))
+                shm_hit = caches.shm_lookup(key)
+                if shm_hit is not None:
+                    out, placement = shm_hit
+                    flc.stats.serve_forwarded += 1
+                    return ({"status": "ok", "mime": out.mime,
+                             "placement": placement or ""}, bytes(out.body))
+
+            async def produce():
+                # request=None: the prefetch only reads op/opts (the
+                # watermark URL rides the params, not the request)
+                wm_rgba = await self._prefetch_watermark(None, op_name, opts)
+                return await self._submit_pool(op_name, body, opts, wm_rgba,
+                                               None, digest)
+
+            async def claimed():
+                # flight OUTSIDE claim, matching the live handler path:
+                # a consistent order means a local leader and a forwarded
+                # request for the same key can never wait on each other
+                if key is not None:
+                    return await flc.run_claimed(
+                        key, cache_mod.shared_key(key), produce, caches)
+                return await produce()
+
+            if caches.coalesce and key is not None:
+                out, placement = await caches.flight.run(key, claimed)
+            else:
+                out, placement = await claimed()
+            if caches.result.enabled and key is not None:
+                caches.result.put(key, (out, placement), len(out.body))
+            flc.stats.serve_forwarded += 1
+            return ({"status": "ok", "mime": out.mime,
+                     "placement": placement or ""}, bytes(out.body))
+        finally:
+            obs_trace.deactivate(token)
 
     # returnSize probes at most this many header bytes when an entry's
     # meta carries no dims (legacy/shm entries): SOF/IHDR live in the
@@ -782,6 +973,11 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
             # counters; absent with --fleet-cache-mb off — the block's
             # presence IS the armed/parity signal
             stats["fleet"] = service.caches.shm.snapshot()
+            if service.coherence is not None:
+                # ownership-plane counters (fleet/ownership.py): the
+                # ring view + forward/claim outcomes; the sub-dict's
+                # presence IS the --fleet-coherence armed signal
+                stats["fleet"]["coherence"] = service.coherence.snapshot()
         if service.options.read_timeout_s > 0:
             # ingress read-guard counters (web/ingress.py)
             from imaginary_tpu.web.ingress import STATS as ingress_stats

@@ -392,6 +392,68 @@ class TestSlotProtocol:
         assert findings == []
 
 
+class TestClaimProtocol:
+    def test_trips_acquire_without_finally_release(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "async def run(shm, key, produce):\n"
+            "    claim = shm.claim_acquire(key)\n"
+            "    out = await produce()\n"  # a raise strands the claim
+            "    shm.claim_release(claim)\n"
+            "    return out\n"
+        )}, rules=["ITPU013"])
+        assert [f.line for f in findings] == [2]
+        assert _rules_hit(findings) == {"ITPU013"}
+
+    def test_trips_release_in_except_not_finally(self, tmp_path):
+        # an except-only release misses the success path AND
+        # non-Exception exits (CancelledError on 3.8+ is BaseException);
+        # the protocol demands a finally
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "async def run(shm, key, produce):\n"
+            "    claim = shm.claim_acquire(key)\n"
+            "    try:\n"
+            "        return await produce()\n"
+            "    except Exception:\n"
+            "        shm.claim_release(claim)\n"
+            "        raise\n"
+        )}, rules=["ITPU013"])
+        assert [f.line for f in findings] == [2]
+
+    def test_release_in_finally_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "async def run(shm, key, produce):\n"
+            "    claim = shm.claim_acquire(key)\n"
+            "    try:\n"
+            "        if claim.won:\n"
+            "            return await produce()\n"
+            "    finally:\n"
+            "        shm.claim_release(claim)\n"
+            "    return None\n"
+        )}, rules=["ITPU013"])
+        assert findings == []
+
+    def test_abandon_in_finally_passes(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def probe(shm, key):\n"
+            "    claim = shm.claim_acquire(key)\n"
+            "    try:\n"
+            "        return claim.won\n"
+            "    finally:\n"
+            "        shm.claim_abandon(claim)\n"
+        )}, rules=["ITPU013"])
+        assert findings == []
+
+    def test_primitives_themselves_exempt(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "class Shm:\n"
+            "    def claim_acquire(self, key):\n"
+            "        return self._claim(self.claim_index(key))\n"
+            "    def claim_release(self, claim):\n"
+            "        self._unlock(claim.idx)\n"
+        )}, rules=["ITPU013"])
+        assert findings == []
+
+
 class TestObsRegistry:
     def test_trips_all_five_directions(self, tmp_path):
         findings, _ = _scan(tmp_path, {
@@ -623,8 +685,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 12 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 12
+        # all 13 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 13
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

@@ -261,9 +261,12 @@ class TestDegradedMesh:
             ex.shutdown()
 
     def test_readmission_restores_lane_and_bumps_generation(self):
+        # the cooldown must outlast the whole error storm: a shorter one
+        # lets the half-open probe re-admit chip 0 MID-storm on a slow
+        # host, fail again, and cycle twice (generation +4, not +2)
         ex = Executor(ExecutorConfig(mesh_policy="lanes", n_devices=4,
                                      window_ms=1.0, breaker_threshold=1,
-                                     breaker_cooldown_s=0.5))
+                                     breaker_cooldown_s=3.0))
         try:
             arr, plan = _img(96, 96), _resize_plan(96, 96)
             [ex.submit(arr, plan).result(timeout=60) for _ in range(4)]

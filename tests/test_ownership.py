@@ -1,0 +1,766 @@
+"""Fleet coherence (ISSUE 19): digest ownership, the claim runner, the
+forward hop, and fleet-wide QoS.
+
+The ring and claim-protocol tests drive the real shm file; the zombie
+(lock-held-but-deposed) shapes that cannot be built from one process —
+POSIX record locks do not self-exclude — use targeted monkeypatching of
+the lock primitive, mirroring how test_fleet.py builds torn slots by
+state surgery. The forward-hop tests run a real Unix-socket
+ForwardServer; the HTTP tests pin the OFF-state byte parity and the
+fail-open ladder end to end (a live two-worker forward rides in
+`make chaos` / bench_chaos rows 11-12).
+"""
+
+import asyncio
+import hashlib
+import io
+import os
+import struct
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from imaginary_tpu import cache as cache_mod
+from imaginary_tpu import deadline as deadline_mod
+from imaginary_tpu import failpoints
+from imaginary_tpu.fleet import ipc, shmcache
+from imaginary_tpu.fleet import ownership as own
+from imaginary_tpu.fleet.shmcache import CLAIM_SLOTS, CLAIMED, ShmCache
+from imaginary_tpu.obs import trace as obs_trace
+from imaginary_tpu.pipeline import ProcessedImage
+from imaginary_tpu.web.config import ServerOptions
+from tests.conftest import fixture_bytes
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+@pytest.fixture()
+def shm(tmp_path):
+    path = str(tmp_path / "fleet.shm")
+    sup = ShmCache(path, create=True, size_mb=2.0, owner=True)
+    worker = ShmCache(path, create=False, worker=0, epoch=0)
+    yield sup, worker
+    worker.close()
+    sup.close()
+
+
+def _key(tag: bytes) -> bytes:
+    return hashlib.sha256(tag).digest()
+
+
+def _claims(shm_, n=200):
+    members = shm_.live_workers()
+    return {own.rendezvous_owner(members, _key(b"k%d" % i))
+            for i in range(n)}
+
+
+# --- rendezvous ring ---------------------------------------------------------
+
+
+class TestRendezvousRing:
+    def test_empty_ring_is_none(self):
+        assert own.rendezvous_owner([], _key(b"x")) is None
+
+    def test_minimal_disruption_on_member_removal(self):
+        # the groupcache property: dropping one member moves ONLY the
+        # keys that member owned — everyone else's assignment is stable
+        full = [(0, 1), (1, 1), (2, 1)]
+        keys = [_key(b"k%d" % i) for i in range(300)]
+        before = {k: own.rendezvous_owner(full, k) for k in keys}
+        assert set(before.values()) == {0, 1, 2}  # all members used
+        after = {k: own.rendezvous_owner([(0, 1), (2, 1)], k) for k in keys}
+        for k in keys:
+            if before[k] != 1:
+                assert after[k] == before[k]
+            else:
+                assert after[k] in (0, 2)
+
+    def test_epoch_does_not_reshard(self):
+        # a respawned worker (same index, new epoch) inherits exactly
+        # its predecessor's digest set
+        keys = [_key(b"r%d" % i) for i in range(100)]
+        a = [own.rendezvous_owner([(0, 1), (1, 2)], k) for k in keys]
+        b = [own.rendezvous_owner([(0, 7), (1, 9)], k) for k in keys]
+        assert a == b
+
+    def test_membership_from_epoch_table(self, shm):
+        sup, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2)
+        assert flc.members() == []  # nothing stamped: standalone mode
+        assert flc.owner_of(_key(b"a")) is None
+        assert flc.is_device_owner()  # no ring -> every worker is owner
+        sup.stamp_epoch(1, 3)
+        sup.stamp_epoch(2, 1)
+        assert flc.members() == [(1, 3), (2, 1)]
+        assert flc.device_owner() == 1  # lowest live index
+        assert not flc.is_device_owner()
+
+
+# --- claim table protocol ----------------------------------------------------
+
+
+class TestClaimProtocol:
+    def test_acquire_release_roundtrip(self, shm):
+        _, w = shm
+        k = _key(b"claim")
+        c = w.claim_acquire(k)
+        assert c.won and w.stats.claims_won == 1
+        state, holder, epoch, kk = w._claim_hdr(c.idx)
+        assert state == CLAIMED and holder == 0 and kk == k
+        assert w.claim_scan()["live"] == 1
+        w.claim_release(c)
+        assert not c.won
+        scan = w.claim_scan()
+        assert scan["live"] == 0 and scan["free"] == CLAIM_SLOTS
+
+    def test_fenced_worker_cannot_claim(self, shm):
+        sup, w = shm
+        sup.stamp_epoch(0, 9)  # a successor for index 0 was stamped
+        c = w.claim_acquire(_key(b"f"))
+        assert not c.won and not c.busy
+        assert w.stats.fenced_claims == 1
+        w.claim_release(c)  # no-op, never raises
+
+    def test_same_process_second_acquire_reads_busy(self, shm):
+        _, w = shm
+        k = _key(b"dup")
+        c1 = w.claim_acquire(k)
+        assert c1.won
+        c2 = w.claim_acquire(k)
+        try:
+            assert not c2.won and c2.busy and c2.holder == 0
+        finally:
+            w.claim_release(c2)
+            w.claim_release(c1)
+
+    def test_dead_holder_claim_is_reclaimed(self, shm):
+        _, w = shm
+        k = _key(b"dead")
+        idx = w.claim_index(k)
+        # a SIGKILLed holder's leavings: CLAIMED entry, kernel-freed lock
+        shmcache._CLAIM_HDR.pack_into(w._mm, w._claim_off(idx),
+                                      CLAIMED, 3, 9, k)
+        c = w.claim_acquire(k)
+        try:
+            assert c.won and w.stats.claims_reclaimed == 1
+        finally:
+            w.claim_release(c)
+
+    def test_zombie_stale_claim_not_honored(self, shm, monkeypatch):
+        sup, w = shm
+        k = _key(b"zombie")
+        idx = w.claim_index(k)
+        # a SIGSTOPped deposed holder: entry stamped with a deposed
+        # epoch AND the kernel lock still held (simulated — record
+        # locks don't self-exclude in-process)
+        shmcache._CLAIM_HDR.pack_into(w._mm, w._claim_off(idx),
+                                      CLAIMED, 2, 5, k)
+        sup.stamp_epoch(2, 9)  # worker 2's successor exists: epoch 5 deposed
+        monkeypatch.setattr(w, "_try_lock_off", lambda off, **kw: False)
+        c = w.claim_acquire(k)
+        assert not c.won and not c.busy and c.stale
+        assert w.stats.claims_stale == 1
+        w.claim_release(c)
+
+    def test_live_holder_claim_reads_busy(self, shm, monkeypatch):
+        sup, w = shm
+        k = _key(b"live")
+        idx = w.claim_index(k)
+        shmcache._CLAIM_HDR.pack_into(w._mm, w._claim_off(idx),
+                                      CLAIMED, 2, 5, k)
+        sup.stamp_epoch(2, 5)  # holder's epoch is current: it is alive
+        monkeypatch.setattr(w, "_try_lock_off", lambda off, **kw: False)
+        c = w.claim_acquire(k)
+        assert not c.won and c.busy and c.holder == 2
+        w.claim_release(c)
+
+    def test_claim_failpoint_fails_open(self, shm):
+        _, w = shm
+        failpoints.activate("fleet.claim=error")
+        try:
+            c = w.claim_acquire(_key(b"fp"))
+            assert not c.won and not c.busy  # caller runs locally
+        finally:
+            failpoints.deactivate()
+        w.claim_release(c)
+
+    def test_claim_sweep_clears_deposed_zombie(self, shm):
+        sup, w = shm
+        k = _key(b"sweep")
+        idx = w.claim_index(k)
+        shmcache._CLAIM_HDR.pack_into(w._mm, w._claim_off(idx),
+                                      CLAIMED, 4, 3, k)
+        sup.stamp_epoch(4, 8)  # deposed
+        assert w.claim_sweep() == 1
+        assert w.claim_scan()["free"] == CLAIM_SLOTS
+
+    def test_sealed_peek_is_stat_free(self, shm):
+        _, w = shm
+        k = _key(b"peek")
+        misses = w.stats.misses
+        assert not w.sealed_peek(k)
+        assert w.stats.misses == misses  # polling never inflates stats
+        w.put(k, b"m", b"body")
+        assert w.sealed_peek(k)
+        assert w.stats.misses == misses and w.stats.hits == 0
+
+
+# --- the claim runner --------------------------------------------------------
+
+
+def _caches_with(shm_):
+    cs = cache_mod.CacheSet(4.0, 0.0, False, 0.0, 0.0, 0.0)
+    cs.attach_shm(shm_)
+    return cs
+
+
+def _req_key(tag: bytes):
+    return (hashlib.sha256(tag).digest(), "resize", ("width", 64))
+
+
+class TestRunClaimed:
+    def test_winner_runs_once_and_deposits(self, shm):
+        _, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2)
+        caches = _caches_with(w)
+        key = _req_key(b"win")
+        skey = cache_mod.shared_key(key)
+        ran = []
+
+        async def produce():
+            ran.append(1)
+            return ProcessedImage(body=b"P" * 64, mime="image/jpeg"), "dev"
+
+        out, placement = asyncio.run(
+            flc.run_claimed(key, skey, produce, caches))
+        assert ran == [1] and placement == "dev"
+        assert w.sealed_peek(skey)  # deposited before the claim dropped
+        assert w.claim_scan()["live"] == 0  # ledgers at rest
+
+    def test_waiter_redeems_sealed_entry(self, shm, monkeypatch):
+        sup, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2,
+                                 claim_wait_s=5.0, poll_s=0.01)
+        caches = _caches_with(w)
+        key = _req_key(b"wait")
+        skey = cache_mod.shared_key(key)
+        busy = shmcache.FleetClaim(w.claim_index(skey), skey)
+        busy.busy, busy.holder = True, 1
+        monkeypatch.setattr(w, "claim_acquire", lambda k: busy)
+
+        async def produce():  # pragma: no cover - must never run
+            raise AssertionError("waiter must redeem, not recompute")
+
+        async def fn():
+            task = asyncio.ensure_future(
+                flc.run_claimed(key, skey, produce, caches))
+            await asyncio.sleep(0.05)
+            # the remote holder deposits, then releases its claim
+            sib = ShmCache(w.path, create=False, worker=1, epoch=0)
+            try:
+                sib.put(skey, b"image/jpeg\nhost", b"R" * 32)
+            finally:
+                sib.close()
+            return await asyncio.wait_for(task, timeout=5.0)
+
+        out, placement = asyncio.run(fn())
+        assert bytes(out.body) == b"R" * 32 and placement == "host"
+        assert flc.stats.waiter_hits == 1 and flc.stats.claim_waits == 1
+
+    def test_wait_budget_exhausted_falls_open(self, shm, monkeypatch):
+        _, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2,
+                                 claim_wait_s=0.05, poll_s=0.01)
+        caches = _caches_with(w)
+        key = _req_key(b"slow")
+        skey = cache_mod.shared_key(key)
+        busy = shmcache.FleetClaim(w.claim_index(skey), skey)
+        busy.busy, busy.holder = True, 1
+        monkeypatch.setattr(w, "claim_acquire", lambda k: busy)
+
+        async def produce():
+            return ProcessedImage(body=b"L" * 16, mime="image/jpeg"), "host"
+
+        out, _ = asyncio.run(flc.run_claimed(key, skey, produce, caches))
+        assert bytes(out.body) == b"L" * 16
+        assert flc.stats.waiter_timeouts == 1
+
+    def test_dead_holder_redispatch(self, shm, monkeypatch):
+        # first acquire: busy behind a live-looking holder; while the
+        # waiter polls, the holder "dies" (its claim entry stays CLAIMED
+        # but the lock frees) -> the next acquire wins and re-dispatches
+        _, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2,
+                                 claim_wait_s=5.0, poll_s=0.01)
+        caches = _caches_with(w)
+        key = _req_key(b"redis")
+        skey = cache_mod.shared_key(key)
+        idx = w.claim_index(skey)
+        real_acquire = w.claim_acquire
+        calls = []
+
+        def acquire(k):
+            if not calls:
+                calls.append(1)
+                shmcache._CLAIM_HDR.pack_into(
+                    w._mm, w._claim_off(idx), CLAIMED, 1, 7, k)
+                busy = shmcache.FleetClaim(idx, k)
+                busy.busy, busy.holder = True, 1
+                return busy
+            return real_acquire(k)
+
+        monkeypatch.setattr(w, "claim_acquire", acquire)
+        # make the stamped holder epoch look live so the busy is honored
+        w.stamp_epoch(1, 7)
+        ran = []
+
+        async def produce():
+            ran.append(1)
+            return ProcessedImage(body=b"D" * 8, mime="image/jpeg"), "host"
+
+        out, _ = asyncio.run(flc.run_claimed(key, skey, produce, caches))
+        assert ran == [1]
+        assert flc.stats.redispatches == 1
+        assert w.stats.claims_reclaimed == 1
+        assert w.claim_scan()["live"] == 0
+
+    def test_produce_failure_releases_claim(self, shm):
+        _, w = shm
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2)
+        caches = _caches_with(w)
+        key = _req_key(b"boom")
+        skey = cache_mod.shared_key(key)
+
+        async def produce():
+            raise RuntimeError("pipeline fault")
+
+        with pytest.raises(RuntimeError):
+            asyncio.run(flc.run_claimed(key, skey, produce, caches))
+        assert w.claim_scan()["live"] == 0  # the finally released it
+
+
+# --- the forward hop ---------------------------------------------------------
+
+
+class TestForwardHop:
+    def _coherence(self, sup, w, hop_s=1.0):
+        sup.stamp_epoch(1, 3)  # ring = [worker 1]: it owns every digest
+        return own.FleetCoherence(w, worker=0, hop_s=hop_s)
+
+    def test_forward_roundtrip_and_deadline_propagation(self, shm, tmp_path):
+        sup, w = shm
+        flc = self._coherence(sup, w, hop_s=5.0)
+        seen = {}
+
+        async def handler(header, body):
+            seen.update(header)
+            seen["body"] = body
+            return {"status": "ok", "mime": "image/jpeg",
+                    "placement": "device"}, b"FWD" * 10
+
+        async def fn():
+            srv = ipc.ForwardServer(ipc.socket_path(w.path, 1), handler)
+            await srv.start()
+            try:
+                tr = obs_trace.RequestTrace("rid", enabled=False)
+                tr.deadline = deadline_mod.Deadline(0.2)
+                token = obs_trace.activate(tr)
+                try:
+                    return await flc.try_forward(
+                        "resize", {"width": "64"}, b"SRC", _key(b"fk"))
+                finally:
+                    obs_trace.deactivate(token)
+            finally:
+                await srv.stop()
+
+        got = asyncio.run(fn())
+        assert got is not None
+        out, placement = got
+        assert bytes(out.body) == b"FWD" * 10 and placement == "device"
+        assert seen["op"] == "resize" and seen["query"] == {"width": "64"}
+        assert seen["body"] == b"SRC"
+        # the hop budget is min(hop, remaining deadline): the 5 s hop
+        # must have been clamped by the 200 ms request budget
+        assert 0 < seen["budget_ms"] <= 200
+        assert flc.stats.forwards == 1
+
+    def test_self_owned_key_is_local(self, shm):
+        sup, w = shm
+        sup.stamp_epoch(0, 0)  # leave ring empty
+        flc = own.FleetCoherence(w, worker=0, hop_s=0.2)
+
+        async def fn():
+            return await flc.try_forward("resize", {}, b"x", _key(b"s"))
+
+        assert asyncio.run(fn()) is None  # empty ring: run locally
+
+    def test_owner_unreachable_fails_open(self, shm):
+        sup, w = shm
+        flc = self._coherence(sup, w)  # owner's socket was never bound
+
+        async def fn():
+            return await flc.try_forward("resize", {}, b"x", _key(b"u"))
+
+        assert asyncio.run(fn()) is None
+        assert flc.stats.forward_fails == 1
+
+    def test_fenced_answer_fails_open(self, shm):
+        sup, w = shm
+        flc = self._coherence(sup, w)
+
+        async def handler(header, body):
+            return {"status": "fenced"}, b""
+
+        async def fn():
+            srv = ipc.ForwardServer(ipc.socket_path(w.path, 1), handler)
+            await srv.start()
+            try:
+                return await flc.try_forward("resize", {}, b"x", _key(b"z"))
+            finally:
+                await srv.stop()
+
+        assert asyncio.run(fn()) is None
+        assert flc.stats.forward_fails == 1
+
+    def test_slow_owner_bounded_by_hop_timeout(self, shm):
+        sup, w = shm
+        flc = self._coherence(sup, w, hop_s=0.1)
+
+        async def handler(header, body):
+            await asyncio.sleep(5.0)
+            return {"status": "ok"}, b""
+
+        async def fn():
+            srv = ipc.ForwardServer(ipc.socket_path(w.path, 1), handler)
+            await srv.start()
+            try:
+                t0 = time.monotonic()
+                got = await flc.try_forward("resize", {}, b"x", _key(b"t"))
+                return got, time.monotonic() - t0
+            finally:
+                await srv.stop()
+
+        got, dt = asyncio.run(fn())
+        assert got is None and dt < 2.0
+        assert flc.stats.forward_fails == 1
+
+    def test_forward_failpoint_fails_open_without_dialing(self, shm):
+        sup, w = shm
+        flc = self._coherence(sup, w)
+        failpoints.activate("fleet.forward=error")
+        try:
+            async def fn():
+                return await flc.try_forward("resize", {}, b"x", _key(b"i"))
+
+            assert asyncio.run(fn()) is None
+        finally:
+            failpoints.deactivate()
+        assert flc.stats.forward_fails == 1
+
+
+# --- HTTP: parity, fail-open, surfaces ---------------------------------------
+
+
+def run(options, fn):
+    async def runner():
+        from imaginary_tpu.web.app import create_app
+
+        app = create_app(options, log_stream=io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, app)
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+def jpg() -> bytes:
+    return fixture_bytes("imaginary.jpg")
+
+
+def _post_kw():
+    return {"data": jpg(), "headers": {"Content-Type": "image/jpeg"}}
+
+
+class TestCoherenceHttp:
+    def test_coherence_off_byte_parity(self):
+        os.environ.pop(shmcache.PATH_ENV, None)
+        bodies = {}
+
+        async def baseline(client, app):
+            r = await client.post("/resize?width=150&height=110", **_post_kw())
+            bodies["off"] = await r.read()
+            h = await (await client.get("/health")).json()
+            assert "fleet" not in h
+            assert app["service"].coherence is None
+
+        async def armed(client, app):
+            r = await client.post("/resize?width=150&height=110", **_post_kw())
+            bodies["on"] = await r.read()
+            h = await (await client.get("/health")).json()
+            assert "coherence" in h["fleet"]
+            assert app["service"].coherence is not None
+            assert app["service"]._forward_server is not None
+
+        run(ServerOptions(), baseline)
+        run(ServerOptions(fleet_cache_mb=4.0, fleet_coherence=True,
+                          cache_coalesce=True), armed)
+        assert bodies["off"] == bodies["on"]
+
+    def test_owner_unreachable_http_fail_open(self):
+        # stamp a phantom sibling that owns EVERY digest (only ring
+        # member) but never bound its socket: every request must fall
+        # open to local execution, byte-identical, no new error class
+        os.environ.pop(shmcache.PATH_ENV, None)
+        bodies = {}
+
+        async def baseline(client, app):
+            r = await client.post("/resize?width=130", **_post_kw())
+            bodies["off"] = await r.read()
+
+        async def armed(client, app):
+            svc = app["service"]
+            svc.caches.shm.stamp_epoch(1, 7)
+            r = await client.post("/resize?width=130", **_post_kw())
+            assert r.status == 200
+            bodies["on"] = await r.read()
+            h = await (await client.get("/health")).json()
+            coh = h["fleet"]["coherence"]
+            assert coh["forward_fails"] >= 1
+            assert coh["members"] == [1]
+            assert coh["device_owner"] == 1
+            assert coh["is_device_owner"] is False
+
+        run(ServerOptions(), baseline)
+        run(ServerOptions(fleet_cache_mb=4.0, fleet_coherence=True), armed)
+        assert bodies["off"] == bodies["on"]
+
+    def test_forward_e2e_between_two_services(self, tmp_path):
+        # two real apps sharing one shm file and one ring: requests to
+        # the NON-owner forward over the Unix hop and serve the owner's
+        # bytes; the owner books serve_forwarded
+        path = str(tmp_path / "e2e.shm")
+        sup = ShmCache(path, create=True, size_mb=4.0, owner=True)
+        sup.stamp_epoch(0, 1)
+        sup.stamp_epoch(1, 1)
+
+        async def fn():
+            from imaginary_tpu.web.app import create_app
+
+            def boot(widx):
+                os.environ[shmcache.PATH_ENV] = path
+                os.environ["IMAGINARY_TPU_WORKER"] = str(widx)
+                os.environ["IMAGINARY_TPU_WORKER_EPOCH"] = "1"
+                try:
+                    # hop budget sized for a COLD first-request compile
+                    # on the owner (prod tunes this to a warm fleet)
+                    return create_app(
+                        ServerOptions(fleet_cache_mb=4.0,
+                                      fleet_coherence=True,
+                                      fleet_hop_ms=15000.0),
+                        log_stream=io.StringIO())
+                finally:
+                    for env in (shmcache.PATH_ENV, "IMAGINARY_TPU_WORKER",
+                                "IMAGINARY_TPU_WORKER_EPOCH"):
+                        os.environ.pop(env, None)
+
+            app0, app1 = boot(0), boot(1)
+            c0 = TestClient(TestServer(app0))
+            c1 = TestClient(TestServer(app1))
+            await c0.start_server()
+            await c1.start_server()
+            try:
+                svc1 = app1["service"]
+                flc1 = svc1.coherence
+                # find a width whose digest worker 0 owns, so a request
+                # into worker 1 must take the forward hop
+                body = jpg()
+                digest = cache_mod.source_digest(body)
+                from imaginary_tpu.params import build_params_from_query
+
+                width = None
+                for cand in range(60, 200):
+                    opts = build_params_from_query({"width": str(cand)})
+                    skey = cache_mod.shared_key(
+                        cache_mod.request_key(digest, "resize", opts))
+                    if flc1.owner_of(skey) == 0:
+                        width = cand
+                        break
+                assert width is not None
+                # cold fleet: the non-owner MUST take the hop (a warm shm
+                # tier would satisfy it before the forward block)
+                fwd = await c1.post(f"/resize?width={width}", **_post_kw())
+                assert fwd.status == 200
+                b_fwd = await fwd.read()
+                assert flc1.stats.forwards == 1
+                assert app0["service"].coherence.stats.serve_forwarded >= 1
+                direct = await c0.post(f"/resize?width={width}", **_post_kw())
+                assert await direct.read() == b_fwd
+            finally:
+                await c0.close()
+                await c1.close()
+
+        try:
+            asyncio.run(fn())
+        finally:
+            sup.close()
+
+
+# --- fleet QoS ---------------------------------------------------------------
+
+
+class TestFleetQos:
+    def test_hog_spray_rate_bounded_fleet_wide(self, shm):
+        # THE evasion fix: a hog spraying two SO_REUSEPORT workers used
+        # to get 2x its GCRA budget (independent local tat stores); the
+        # shared tat bounds the FLEET admission at rate*(1+eps)
+        _, w = shm
+        w2 = ShmCache(w.path, create=False, worker=1, epoch=0)
+        try:
+            clock = [1000.0]
+            fqs = [own.FleetQos(h, clock=lambda: clock[0])
+                   for h in (w, w2)]
+            rate, burst, dur = 50.0, 10, 2.0
+            emission, tau = 1.0 / rate, burst / rate
+
+            def spray(decide):
+                admitted, i = 0, 0
+                clock[0] = 1000.0
+                end = 1000.0 + dur
+                while clock[0] < end:
+                    if decide(i)[0]:
+                        admitted += 1
+                    i += 1
+                    clock[0] += 0.004  # 250 attempts/s: a 5x hog
+                return admitted
+
+            fleet = spray(lambda i: fqs[i % 2].gcra_allow(
+                "hog", emission, tau))
+            budget = burst + rate * dur
+            assert fleet <= budget * 1.05 + 2  # fleet-wide: ONE budget
+
+            # the old per-worker shape for contrast: two INDEPENDENT tat
+            # stores (GCRARateLimiter state before the shm table) — the
+            # same spray pockets nearly double the contract
+            tats = [{}, {}]
+
+            def local_allow(i):
+                store = tats[i % 2]
+                tat = max(store.get("hog", clock[0]), clock[0])
+                if tat - clock[0] > tau:
+                    return (False,)
+                store["hog"] = tat + emission
+                return (True,)
+
+            assert spray(local_allow) >= 1.8 * budget  # the evasion
+        finally:
+            w2.close()
+
+    def test_limiter_consults_fleet_registry(self, shm):
+        from imaginary_tpu.qos.limiter import TenantLimiter
+        from imaginary_tpu.qos.tenancy import TenantSpec
+
+        _, w = shm
+        clock = [500.0]
+        own.set_fleet_qos(own.FleetQos(w, clock=lambda: clock[0]))
+        try:
+            lim = TenantLimiter(1000, 0)
+            ten = TenantSpec(name="t1", rate=2.0, burst=0)
+            assert lim.allow(ten)[0] is True
+            ok, retry = lim.allow(ten)  # same instant: over the 2/s rate
+            assert ok is False and retry > 0
+            clock[0] += 0.6  # one emission interval later
+            assert lim.allow(ten)[0] is True
+            # the decision state lives in the SHM table, not the local
+            # store: the local GCRA never minted a key
+            assert "tenant:t1" not in lim._gcra._tat
+        finally:
+            own.set_fleet_qos(None)
+
+    def test_share_charges_are_epoch_fenced(self, shm):
+        sup, w = shm
+        w2 = ShmCache(w.path, create=False, worker=1, epoch=0)
+        try:
+            assert w.qos_share_charge("ten", cap=2) is True
+            assert w2.qos_share_charge("ten", cap=2) is True
+            assert w.qos_share_total("ten") == 2
+            # fleet cap reached: the third charge anywhere sheds
+            assert w.qos_share_charge("ten", cap=2) is False
+            # worker 1 is SIGKILLed with its charge stuck; stamping its
+            # successor's epoch self-heals the column — no sweeper
+            sup.stamp_epoch(1, 5)
+            assert w.qos_share_total("ten") == 1
+            assert w.qos_share_charge("ten", cap=2) is True
+            w.qos_share_release("ten")
+            w.qos_share_release("ten")
+            assert w.qos_share_total("ten") == 0
+        finally:
+            w2.close()
+
+    def test_scheduler_fleet_share_cap(self, shm):
+        from imaginary_tpu.qos.sched import FairScheduler
+        from imaginary_tpu.qos.shed import TenantShareExceeded
+        from imaginary_tpu.qos.tenancy import parse_policy
+
+        _, w = shm
+        policy = parse_policy('{"queue_cap": 8}')
+
+        class Item:
+            def __init__(self, name):
+                self.qos = (name, 1, 0.25, None)
+
+        sched = FairScheduler(policy)
+        cap = max(1, int(policy.queue_cap * 0.25))
+        own.set_fleet_qos(own.FleetQos(w))
+        try:
+            # a sibling worker already holds the whole fleet share
+            sib = ShmCache(w.path, create=False, worker=1, epoch=0)
+            try:
+                for _ in range(cap):
+                    assert sib.qos_share_charge("spam", cap) is True
+                with pytest.raises(TenantShareExceeded):
+                    sched.put(Item("spam"))  # local queue empty, fleet full
+                for _ in range(cap):
+                    sib.qos_share_release("spam")
+                sched.put(Item("spam"))  # released fleet-wide: admitted
+                got = sched.get_nowait()
+                assert got.qos[0] == "spam"
+                assert w.qos_share_total("spam") == 0  # pop released it
+            finally:
+                sib.close()
+        finally:
+            own.set_fleet_qos(None)
+
+    def test_qos_counters_monotonic_through_respawn(self):
+        # the /fleetz merge contract for the imaginary_tpu_qos_* families:
+        # an owner respawn (epoch bump, counters reset to zero) must fold
+        # the dead incarnation into the retired base, never dip the total
+        from imaginary_tpu.obs.aggregate import Aggregator, parse_exposition
+
+        def expo(n):
+            return parse_exposition(
+                "# HELP imaginary_tpu_qos_admitted_total Admissions.\n"
+                "# TYPE imaginary_tpu_qos_admitted_total counter\n"
+                f'imaginary_tpu_qos_admitted_total{{class="standard"}} {n}\n')
+
+        def total(agg):
+            for line in agg.render().splitlines():
+                if line.startswith("imaginary_tpu_qos_admitted_total{"):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError("family missing from merge")
+
+        agg = Aggregator()
+        agg.observe(0, 1, expo(10))
+        assert total(agg) == 10.0
+        agg.observe(0, 4, expo(0))  # respawned owner, counters reset
+        assert total(agg) == 10.0  # never backwards
+        agg.observe(0, 4, expo(3))
+        assert total(agg) == 13.0
