@@ -29,7 +29,9 @@ gate: lint native-entropy dct-parity test chaos
 	  { echo "bench_stages.py byte-touch/spill gates failed - snapshot NOT green"; exit 1; }
 	BENCH_DURATION=4 BENCH_THREADS=8 BENCH_COHERENCE_ONLY=1 python bench_workers.py || \
 	  { echo "bench_workers.py fleet-coherence gates failed - snapshot NOT green"; exit 1; }
-	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device/stages/coherence benches all pass"
+	BENCH_DURATION=4 BENCH_THREADS=8 BENCH_MULTIHOST_ONLY=1 python bench_workers.py || \
+	  { echo "bench_workers.py multi-host gates failed - snapshot NOT green"; exit 1; }
+	@echo "GATE GREEN: itpucheck + tests + dryrun + chaos + bench + cache/obs/deadline/qos/memory/device/stages/coherence/multihost benches all pass"
 
 # Chaos drill (ISSUE 4 + ISSUE 6 + ISSUE 7 + ISSUE 10 + ISSUE 11): the
 # deadline/failpoint/devhealth/pressure/integrity/fleet suites, then
@@ -60,7 +62,12 @@ gate: lint native-entropy dct-parity test chaos
 # availability, fleet singleflight bound on publishes, claim table at
 # rest after one sweep) and a SIGSTOP zombie owner (its identity refused
 # at claim_acquire, a deposed live holder read STALE and swept); counters
-# archived to artifacts/chaos_ownership.json.
+# archived to artifacts/chaos_ownership.json. Row 13 (ISSUE 20) boots a
+# REAL 2-host cluster (two cross-peered supervisors, --router) and
+# SIGKILLs one whole host mid-storm: availability holds >= 99% on the
+# survivor, its fleet metrics stay monotonic, and the dead host rejoins
+# under a bumped host epoch; counters archived to
+# artifacts/chaos_multihost.json.
 chaos:
 	python -m pytest tests/test_failpoints.py tests/test_deadline.py tests/test_qos.py tests/test_devhealth.py tests/test_pressure.py tests/test_integrity.py tests/test_fleet.py tests/test_ownership.py -q -m 'not slow'
 	BENCH_DURATION=4 BENCH_CONCURRENCY=8 \

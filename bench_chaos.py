@@ -1835,6 +1835,289 @@ def _ownership_zombie_row(duration: float, concurrency: int) -> tuple:
     return 0, row
 
 
+class _MultihostCluster:
+    """Two real 2-worker supervisor fleets (distinct host ids, admin
+    planes and shm files) cross-pointed via --peers, sharing one origin.
+    The smallest honest cluster: gossip, routing and spillover all ride
+    real sockets between real supervisors."""
+
+    def __init__(self):
+        self.origin_runner = None
+        self.origin_base = None
+        self.ports = {}
+        self.admins = {}
+        self.paths = {}
+        self.sups = {}
+
+    async def start(self):
+        from bench_cache import N_URLS, _start_origin
+        from bench_util import free_port, make_1080p_jpeg
+
+        base_jpeg = make_1080p_jpeg()
+        variants = [base_jpeg + b"\x00" * (i + 1) for i in range(N_URLS)]
+        self.origin_runner, self.origin_base = await _start_origin(variants)
+        for h in ("a", "b"):
+            self.ports[h] = free_port()
+            self.admins[h] = free_port()
+            fd, path = tempfile.mkstemp(prefix=f"chaos-mh-{h}-",
+                                        suffix=".shm")
+            os.close(fd)
+            os.unlink(path)
+            self.paths[h] = path
+        for h in ("a", "b"):
+            self.spawn(h)
+
+    def spawn(self, h: str):
+        peer = "b" if h == "a" else "a"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for k in ("IMAGINARY_TPU_WORKER", "IMAGINARY_TPU_WORKER_EPOCH",
+                  "IMAGINARY_TPU_FAILPOINTS", "IMAGINARY_TPU_HOST_ID",
+                  "IMAGINARY_TPU_HOST_EPOCH"):
+            env.pop(k, None)
+        env["IMAGINARY_TPU_FLEET_PATH"] = self.paths[h]
+        self.sups[h] = subprocess.Popen(
+            [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
+             "--port", str(self.ports[h]), "--enable-url-source",
+             "--cache-result-mb", "16", "--fleet-cache-mb", "16",
+             "--request-timeout", "10", "--host-id", f"host-{h}",
+             "--fleet-admin-port", str(self.admins[h]),
+             "--peers", f"http://127.0.0.1:{self.admins[peer]}",
+             "--router", "--peer-probe-interval", "0.3"],
+            cwd=ROOT, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return self.sups[h]
+
+    async def health(self, session, h: str, timeout=2.0):
+        async with session.get(
+                f"http://127.0.0.1:{self.ports[h]}/health",
+                headers={"Connection": "close"},
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            return await r.json()
+
+    async def wait_workers(self, session, h: str, n=2,
+                           deadline_s=120.0) -> dict:
+        seen: dict = {}
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            if self.sups[h].poll() is not None:
+                raise RuntimeError(
+                    f"host {h} supervisor exited {self.sups[h].poll()} "
+                    "during boot")
+            try:
+                hh = await self.health(session, h)
+                seen[hh["worker"]] = {"pid": hh["pid"],
+                                      "epoch": hh["epoch"]}
+                if len(seen) >= n:
+                    return seen
+            except Exception:
+                pass
+            await asyncio.sleep(0.2)
+        raise RuntimeError(f"host {h} never reached {n} workers ({seen})")
+
+    async def cluster_view(self, session, h: str) -> dict:
+        async with session.get(
+                f"http://127.0.0.1:{self.admins[h]}/fleetz?scope=cluster",
+                headers={"Connection": "close"},
+                timeout=aiohttp.ClientTimeout(total=2.0)) as r:
+            return await r.json()
+
+    def url(self, i: int) -> str:
+        return (f"http://127.0.0.1:{self.ports['a']}/resize?width=300"
+                f"&height=200&url={self.origin_base}/img/{i}")
+
+    async def stop(self):
+        for sup in self.sups.values():
+            if sup is not None and sup.poll() is None:
+                sup.send_signal(signal.SIGTERM)
+        for sup in self.sups.values():
+            if sup is None:
+                continue
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, sup.wait, 20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                sup.wait()
+        if self.origin_runner is not None:
+            await self.origin_runner.cleanup()
+        for path in self.paths.values():
+            if path and os.path.exists(path):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+
+async def _multihost_kill_soak(duration: float, concurrency: int) -> dict:
+    from bench_cache import N_URLS, ZIPF_S, _zipf_indices
+
+    cluster = _MultihostCluster()
+    counts: dict = {}
+    out = {"ok": 0, "fail": 0, "monotonic": True, "regressions": [],
+           "routing": {}, "epoch_bumps": 0, "b_rejoined": False,
+           "killed": 0}
+    try:
+        await cluster.start()
+        conn = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            await cluster.wait_workers(session, "a")
+            workers_b = await cluster.wait_workers(session, "b")
+            # gossip convergence: A's admin must see host-b alive before
+            # the storm (the workers' own tables ride the same cadence)
+            end = time.monotonic() + 60.0
+            while time.monotonic() < end:
+                view = await cluster.cluster_view(session, "a")
+                if view.get("hosts", {}).get("host-b", {}).get("alive"):
+                    break
+                await asyncio.sleep(0.3)
+            else:
+                raise RuntimeError("A never gossiped host-b alive")
+            await asyncio.sleep(1.0)
+
+            # per-pid multihost counter streams from A's /health: every
+            # sample must be >= the last for that pid (counters only grow
+            # — a reset would mean state was lost without a worker death)
+            last: dict = {}
+            stop_sampling = asyncio.Event()
+
+            async def sample_monotonic():
+                fields = ("forwards", "forward_fails", "fenced_answers",
+                          "spills", "spill_fails", "served_for_peer",
+                          "local_fallbacks")
+                while not stop_sampling.is_set():
+                    try:
+                        h = await cluster.health(session, "a", timeout=1.5)
+                        snap = h.get("multihost")
+                        if isinstance(snap, dict):
+                            pid = h["pid"]
+                            prev = last.get(pid)
+                            cur = {f: snap.get(f, 0) for f in fields}
+                            if prev is not None:
+                                for f in fields:
+                                    if cur[f] < prev[f]:
+                                        out["monotonic"] = False
+                                        out["regressions"].append(
+                                            {"pid": pid, "field": f,
+                                             "from": prev[f],
+                                             "to": cur[f]})
+                            last[pid] = cur
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.15)
+
+            sampler = asyncio.create_task(sample_monotonic())
+
+            async def client(k: int):
+                idx = _zipf_indices(6000 + k, N_URLS, ZIPF_S)
+                j = 0
+                while time.monotonic() < storm_end:
+                    okd = await _lb_get(session, cluster.url(idx[j % len(idx)]),
+                                        counts)
+                    out["ok" if okd else "fail"] += 1
+                    j += 1
+
+            storm_end = time.monotonic() + max(duration, 8.0)
+            kill_at = time.monotonic() + max(duration, 8.0) * 0.35
+            clients = [asyncio.create_task(client(k))
+                       for k in range(concurrency)]
+
+            # mid-storm: SIGKILL the WHOLE of host B — supervisor and
+            # both workers, no grace, no drain
+            while time.monotonic() < kill_at:
+                await asyncio.sleep(0.1)
+            victims = [cluster.sups["b"].pid] + \
+                [w["pid"] for w in workers_b.values()]
+            print(f"[chaos] multihost: SIGKILL host-b entirely "
+                  f"(pids {victims})", file=sys.stderr)
+            for pid in victims:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    out["killed"] += 1
+                except ProcessLookupError:
+                    pass
+            cluster.sups["b"].wait()
+
+            # let the storm run against the half-cluster, then restart
+            # host B (same id, FRESH minted epoch) while clients still run
+            await asyncio.sleep(max(duration, 8.0) * 0.25)
+            if os.path.exists(cluster.paths["b"]):
+                os.unlink(cluster.paths["b"])
+            cluster.spawn("b")
+            await asyncio.gather(*clients)
+            stop_sampling.set()
+            await sampler
+
+            # B rejoins the cluster under a bumped host epoch
+            end = time.monotonic() + 90.0
+            while time.monotonic() < end:
+                try:
+                    view = await cluster.cluster_view(session, "a")
+                    hb = view.get("hosts", {}).get("host-b", {})
+                    if hb.get("alive") and hb.get("epoch_bumps", 0) >= 1:
+                        out["b_rejoined"] = True
+                        out["epoch_bumps"] = hb["epoch_bumps"]
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.3)
+            out["routing"] = {
+                f: sum(c.get(f, 0) for c in last.values())
+                for f in ("forwards", "forward_fails", "fenced_answers",
+                          "served_for_peer", "local_fallbacks")}
+    finally:
+        await cluster.stop()
+    out["counts"] = counts
+    return out
+
+
+def _multihost_kill_row(duration: float, concurrency: int) -> tuple:
+    got = asyncio.run(_multihost_kill_soak(duration, concurrency))
+    total = got["ok"] + got["fail"]
+    routing = got["routing"]
+    row = {
+        "metric": "chaos_multihost_kill",
+        "requests": total,
+        "ok": got["ok"],
+        "ok_ratio": round(got["ok"] / total, 4) if total else 0.0,
+        "killed_pids": got["killed"],
+        "monotonic": got["monotonic"],
+        "regressions": got["regressions"][:8],
+        "b_rejoined": got["b_rejoined"],
+        "epoch_bumps": got["epoch_bumps"],
+        "routing": routing,
+        "counts": {str(k): v for k, v in sorted(got["counts"].items(),
+                                                key=str)},
+    }
+    print(json.dumps(row))
+    fails = []
+    if total == 0:
+        fails.append("multihost kill storm produced zero requests")
+    if total and got["ok"] / total < 0.99:
+        fails.append(f"availability {got['ok']}/{total} below 99% with "
+                     "host-b SIGKILLed mid-storm (fail-open broke)")
+    if got["killed"] < 3:
+        fails.append(f"only {got['killed']} host-b pids killed (wanted "
+                     "supervisor + 2 workers)")
+    if not got["monotonic"]:
+        fails.append(f"fleet metrics regressed: {got['regressions'][:3]}")
+    if not got["b_rejoined"]:
+        fails.append("host-b never rejoined the cluster view with a "
+                     "bumped host epoch")
+    if sum(routing.values()) == 0:
+        fails.append("router never exercised (no forwards, fails or "
+                     "fallbacks booked on host-a)")
+    if fails:
+        for f in fails:
+            print(f"[chaos] FAIL: {f}", file=sys.stderr)
+        return 1, row
+    print(f"[chaos] PASS (multihost host-kill): {got['ok']}/{total} ok "
+          f"with host-b dead mid-storm, metrics monotonic, rejoined with "
+          f"{got['epoch_bumps']} epoch bump(s), routing {routing}",
+          file=sys.stderr)
+    return 0, row
+
+
 def main() -> int:
     from imaginary_tpu import failpoints
     from bench_util import ensure_native_built
@@ -1970,7 +2253,23 @@ def main() -> int:
     except OSError as e:
         print(f"[chaos] WARN: could not archive ownership counters: {e}",
               file=sys.stderr)
-    return rc_own_kill or rc_own_zombie
+    if rc_own_kill or rc_own_zombie:
+        return rc_own_kill or rc_own_zombie
+    # ROW 13 (ISSUE 20): a whole 2-worker host SIGKILLed out of a 2-host
+    # cluster mid-storm — availability holds on the survivor, its fleet
+    # metrics stay monotonic, and the dead host rejoins under a bumped
+    # host epoch
+    rc_mh, mh_row = _multihost_kill_row(duration, concurrency)
+    try:
+        with open("artifacts/chaos_multihost.json", "w") as f:
+            json.dump({"multihost_kill": mh_row}, f, indent=2,
+                      sort_keys=True)
+        print("[chaos] multihost counters archived to "
+              "artifacts/chaos_multihost.json", file=sys.stderr)
+    except OSError as e:
+        print(f"[chaos] WARN: could not archive multihost counters: {e}",
+              file=sys.stderr)
+    return rc_mh
 
 
 if __name__ == "__main__":

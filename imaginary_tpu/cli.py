@@ -597,6 +597,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int,
                    default=_env_int("IMAGINARY_TPU_PROCESS_ID", -1),
                    help="this process's index (auto-discovered on TPU pods)")
+    p.add_argument("--peers",
+                   default=_env_str("IMAGINARY_TPU_PEERS", ""),
+                   help="peer supervisor admin bases (http://host:admin-port)"
+                        " as a CSV/whitespace list or @file; arms the "
+                        "multi-host plane: host identity, /fleetz gossip, "
+                        "digest routing and pressure spillover; empty = "
+                        "entirely off (parity)")
+    p.add_argument("--router", action="store_true",
+                   default=_env_bool("IMAGINARY_TPU_ROUTER"),
+                   help="route non-owned digests one HTTP hop to the "
+                        "rendezvous owner host (requires --peers); without "
+                        "it only requests carrying an X-Imaginary-Route: "
+                        "route hint are routed")
+    p.add_argument("--host-id",
+                   default=_env_str("IMAGINARY_TPU_HOST_ID", ""),
+                   help="stable host identity for cross-host rendezvous "
+                        "and fencing (default: hostname)")
+    p.add_argument("--peer-probe-interval", type=float,
+                   default=_env_float("IMAGINARY_TPU_PEER_PROBE_INTERVAL",
+                                      2.0),
+                   help="gossip poll cadence against each peer's /fleetz, "
+                        "seconds")
+    p.add_argument("--mesh-hosts", type=int,
+                   default=_env_int("IMAGINARY_TPU_MESH_HOSTS", 0),
+                   help="join an N-host jax.distributed device mesh at "
+                        "serving boot (requires --coordinator-address and "
+                        "--process-id, single-worker only) so oversize "
+                        "spatial work can shard across hosts; <=1 = off")
     return p
 
 
@@ -656,6 +684,32 @@ def options_from_args(args) -> ServerOptions:
             load_slo_config(args.slo_config)
         except ValueError as e:
             raise SystemExit(str(e)) from None
+    if args.router and not args.peers:
+        # a router with no peer table can never route; refusing at boot
+        # beats silently serving single-host behind a lying flag
+        raise SystemExit("--router requires --peers (the routing ring is "
+                         "built from the gossiped peer table)")
+    if args.peers:
+        # boot-time discipline as for --qos-config: an unreadable @file
+        # or empty list must refuse to start, not gossip into the void
+        from imaginary_tpu.fleet import multihost
+
+        try:
+            if not multihost.parse_peers(args.peers):
+                raise ValueError("--peers resolved to an empty peer list")
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+    if args.mesh_hosts > 1:
+        if not args.coordinator_address:
+            raise SystemExit(
+                "--mesh-hosts requires --coordinator-address (process 0 of "
+                "the mesh)")
+        if args.process_id < 0:
+            raise SystemExit("--mesh-hosts requires --process-id")
+        if _resolve_workers(args.workers) != 1:
+            # each mesh process owns its host's chips outright; a local
+            # worker fleet would fight the mesh for the same devices
+            raise SystemExit("--mesh-hosts requires --workers 1")
     if args.cost_attribution:
         # same boot-time discipline: a typo'd window spec must refuse to
         # start, not silently attribute into malformed windows
@@ -770,6 +824,11 @@ def options_from_args(args) -> ServerOptions:
         coordinator_address=args.coordinator_address,
         num_processes=args.num_processes or None,
         process_id=args.process_id if args.process_id >= 0 else None,
+        peers=args.peers,
+        router=args.router,
+        host_id=args.host_id,
+        peer_probe_interval=max(0.05, args.peer_probe_interval),
+        mesh_hosts=max(0, args.mesh_hosts),
     )
 
 
@@ -782,6 +841,22 @@ def main(argv=None) -> int:
 
     if args.gzip:  # ref: imaginary.go:168-171
         print("warning: -gzip flag is deprecated and will not have effect")
+
+    # Multi-host identity: stamped into the ENVIRONMENT (not options) so
+    # supervisor-spawned workers inherit the same (host_id, host_epoch)
+    # incarnation verbatim — a worker must never mint its own host epoch.
+    host_info = None
+    if o.peers:
+        from imaginary_tpu.fleet import multihost
+
+        hid, hepoch = multihost.ensure_host_identity(o.host_id)
+        scheme = "https" if o.cert_file and o.key_file else "http"
+        host_info = {
+            "id": hid,
+            "epoch": hepoch,
+            "serve_url": (f"{scheme}://{o.address or '127.0.0.1'}:{o.port}"
+                          f"{o.path_prefix.rstrip('/')}"),
+        }
 
     # Multi-process serving: the parent becomes the supervisor and the
     # workers re-enter main() marked by WORKER_ENV (web/workers.py holds
@@ -814,7 +889,9 @@ def main(argv=None) -> int:
                 list(argv) if argv is not None else sys.argv[1:],
                 o.workers, health_url=health_url, fleet=fleet,
                 roll_grace_s=o.fleet_roll_grace_s,
-                admin_port=o.fleet_admin_port)
+                admin_port=o.fleet_admin_port,
+                host_info=host_info, peers=o.peers,
+                peer_probe_interval=o.peer_probe_interval)
         finally:
             if fleet is not None:
                 fleet.close()
@@ -845,7 +922,8 @@ def main(argv=None) -> int:
     # device under --require-device — and is joined after the rest of the
     # bootstrap, before prewarm/serve.
     probe_proc = None
-    if args.require_device or (not platform and not o.distributed):
+    if args.require_device or (not platform and not o.distributed
+                               and o.mesh_hosts <= 1):
         probe_proc = _start_device_probe(platform=platform,
                                          require_accel=args.require_device)
 
@@ -857,6 +935,19 @@ def main(argv=None) -> int:
         init_distributed(
             coordinator_address=o.coordinator_address or None,
             num_processes=o.num_processes,
+            process_id=o.process_id,
+        )
+    elif o.mesh_hosts > 1:
+        # --mesh-hosts is --distributed sugar scoped to serving boot: N
+        # single-worker hosts join one device mesh BEFORE backend init,
+        # so the executor's spatial axis (--spatial-mpix oversize path)
+        # can see every host's chips; profitability gating is unchanged
+        # (the mesh only wins where the spatial policy already shards)
+        from imaginary_tpu.parallel.mesh import init_distributed
+
+        init_distributed(
+            coordinator_address=o.coordinator_address or None,
+            num_processes=o.mesh_hosts,
             process_id=o.process_id,
         )
 

@@ -79,6 +79,16 @@ Sites (each named for the subsystem boundary it sits on):
                    worker index — an error() forces the fail-open
                    local fallback, a delay() burns the hop budget so
                    the deadline-bounded timeout path runs for real
+  peer.forward     the cross-HOST forward/spill hop, client side,
+                   before the dial (fleet/router.py); keyable by the
+                   owning peer's host id — an error() forces the
+                   fail-open local run, a delay() burns the hop budget
+                   against the request deadline
+  peer.health      one gossip probe of a peer's /fleetz
+                   (fleet/multihost.py GossipAgent); keyable by the
+                   peer base URL — an error() makes that peer look
+                   dead to gossip (routing + spillover route around
+                   it) without killing anything
 
 Spec grammar (env `IMAGINARY_TPU_FAILPOINTS` or PUT /debugz/failpoints):
 
@@ -131,6 +141,8 @@ SITES = (
     "worker.zombie",
     "fleet.claim",
     "fleet.forward",
+    "peer.forward",
+    "peer.health",
 )
 
 # keyed-site spelling: site[key], key limited to a safe token charset

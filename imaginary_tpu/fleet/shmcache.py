@@ -99,6 +99,7 @@ _OFF_NSLOTS = 8
 _OFF_SLOT_BYTES = 12
 _OFF_TICK = 16
 _OFF_EPOCHS = 24  # MAX_WORKERS x u64
+_OFF_HOST_EPOCH = _OFF_EPOCHS + MAX_WORKERS * 8  # u64, this HOST's incarnation
 
 # claim table (fleet singleflight): [HEADER_BYTES, _QOS_OFF)
 CLAIM_SLOTS = 64
@@ -283,9 +284,15 @@ class ShmCache:
                     f"{path} slot geometry {slot_bytes} != {SLOT_BYTES} "
                     "(fleet processes must run the same build)")
         # the creator stamps its own epoch so a standalone single
-        # process (no supervisor) is never fenced against itself
+        # process (no supervisor) is never fenced against itself; same
+        # for the host incarnation when the multi-host plane is armed
         if create:
             self.stamp_epoch(self.worker, self.epoch)
+            from imaginary_tpu.fleet import multihost
+
+            he = multihost.host_epoch()
+            if he:
+                self.stamp_host_epoch(he)
 
     # -- constructors ----------------------------------------------------
 
@@ -376,6 +383,30 @@ class ShmCache:
         """True when a successor for this worker index has been stamped:
         this process may read but must not publish."""
         return self.epoch_of(self.worker) != self.epoch
+
+    def stamp_host_epoch(self, epoch: int) -> None:
+        """Supervisor-side: record this HOST's current incarnation.
+        Promotes PR 11's worker fencing one level up — after a host
+        restart the new supervisor stamps a strictly larger epoch, so
+        any process still mapping the old incarnation's view of this
+        host is deposed wholesale, exactly like a replaced worker."""
+        struct.pack_into("<Q", self._mm, _OFF_HOST_EPOCH, int(epoch))
+
+    def host_epoch_stamp(self) -> int:
+        (e,) = struct.unpack_from("<Q", self._mm, _OFF_HOST_EPOCH)
+        return e
+
+    def host_fenced(self) -> bool:
+        """True when the header carries a NEWER host incarnation than
+        this process was born into: a host-level zombie. Zero on either
+        side means the multi-host plane is unarmed — never fenced."""
+        stamped = self.host_epoch_stamp()
+        if not stamped:
+            return False
+        from imaginary_tpu.fleet import multihost
+
+        mine = multihost.host_epoch()
+        return bool(mine) and mine < stamped
 
     def live_workers(self) -> list:
         """(idx, epoch) for every stamped worker — the ownership ring's

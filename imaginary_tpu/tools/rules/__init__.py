@@ -18,6 +18,7 @@ from imaginary_tpu.tools.rules import (
     ledger,
     metrics_exposition,
     obs_registry,
+    peer_timeout,
     silent_except,
     slot_protocol,
 )
@@ -36,4 +37,5 @@ RULES = (
     claim_protocol,
     obs_registry,
     label_cardinality,
+    peer_timeout,
 )

@@ -112,6 +112,10 @@ def create_app(o: ServerOptions, log_stream=None) -> web.Application:
         # fleet forward-hop server (fleet/ipc.py): bound here because it
         # needs the running loop; no-op unless --fleet-coherence armed
         await service.start_coherence()
+        # cross-host gossip thread (fleet/multihost.py): started with
+        # the server, not the constructor, so a Service built for a unit
+        # test never spins a polling thread; no-op unless --peers armed
+        service.start_multihost()
 
     async def on_cleanup(app):
         from imaginary_tpu.obs import looplag

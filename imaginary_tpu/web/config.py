@@ -309,6 +309,21 @@ class ServerOptions:
     coordinator_address: str = ""
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    # --- multi-host serving plane (fleet/multihost.py, fleet/router.py) ------
+    # Peer supervisor admin bases: CSV/whitespace list or @file. "" = the
+    # entire cross-host tier OFF (parity: no gossip thread, no peer table,
+    # no route/spill surfaces, responses byte-identical to single-host).
+    peers: str = ""
+    # Route non-owned digests one HTTP hop to the rendezvous owner host.
+    # Off = route only requests carrying an X-Imaginary-Route: route hint.
+    router: bool = False
+    # Stable host identity for rendezvous + fencing; "" = hostname.
+    host_id: str = ""
+    # Gossip poll cadence against each peer's /fleetz, seconds.
+    peer_probe_interval: float = 2.0
+    # Serving-boot jax.distributed mesh: join an N-host device mesh before
+    # backend init so oversize spatial work shards across hosts. <=1 = off.
+    mesh_hosts: int = 0
 
     def is_endpoint_enabled(self, path: str) -> bool:
         """Endpoint disabling by last path segment (ref: server.go:57-66)."""

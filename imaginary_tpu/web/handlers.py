@@ -157,6 +157,20 @@ class ImageService:
                 ownership_mod.set_fleet_qos(
                     ownership_mod.FleetQos(self.caches.shm))
                 self._armed_fleet_qos = True
+        # cross-host plane (fleet/multihost.py + fleet/router.py): None
+        # unless --peers — parity: no peer table, no gossip thread, no
+        # route/spill code on the request path, no new headers.
+        self.multihost = None
+        if o.peers:
+            from imaginary_tpu.fleet import multihost as multihost_mod
+            from imaginary_tpu.fleet import router as router_mod
+
+            hid, hepoch = multihost_mod.ensure_host_identity(o.host_id)
+            self.multihost = router_mod.HostRouter(
+                multihost_mod.PeerTable(multihost_mod.parse_peers(o.peers)),
+                self_id=hid, self_epoch=hepoch, route_all=o.router,
+                hop_s=o.fleet_hop_ms / 1000.0,
+                probe_interval_s=o.peer_probe_interval)
         self.frame_cache = cache_mod.FrameCache(self.caches.frames,
                                                 self.caches.stats)
         self.registry = SourceRegistry(o, caches=self.caches)
@@ -283,7 +297,16 @@ class ImageService:
         host_wait = backlog * self._service_ewma_ms / max(1, self._pool_workers)
         return host_wait + self.executor.estimated_wait_ms()
 
+    def start_multihost(self) -> None:
+        """Start the cross-host gossip thread (no-op with --peers off).
+        Called from the app's on_startup hook next to start_coherence so
+        unit-test Services never spin a poller."""
+        if self.multihost is not None:
+            self.multihost.start()
+
     async def close(self):
+        if self.multihost is not None:
+            self.multihost.close()
         await self.stop_coherence()
         if self._armed_fleet_qos:
             # unregister OUR handle only (tests boot many apps per
@@ -370,6 +393,18 @@ class ImageService:
                 from imaginary_tpu.qos.shed import shed_for_pressure
 
                 if qos is not None and shed_for_pressure(plevel, kidx):
+                    # cross-host spillover (fleet/router.py): work this
+                    # host is about to shed is first OFFERED to the
+                    # least-loaded non-critical peer from gossip; a
+                    # failed offer falls through to the 503 the request
+                    # was owed anyway — strictly no worse than shedding
+                    if self.multihost is not None:
+                        spilled = await self._try_spill(request)
+                        if spilled is not None:
+                            if tr is not None:
+                                tr.annotate(
+                                    placement_attempts=["spill_peer"])
+                            return spilled
                     gov.note_shed()
                     qos.stats.note_shed(kidx)
                     if tr is not None:
@@ -441,6 +476,35 @@ class ImageService:
             return error_response(request, e, o)
         except ParamError as e:
             return error_response(request, new_error(str(e), 400), o)
+
+    async def _try_spill(self, request) -> Optional[web.Response]:
+        """Offer one about-to-shed request to the least-loaded
+        non-critical peer (cross-host spillover). The ORIGINAL request
+        ships verbatim — method, path+query, body — and the peer runs
+        its own fetch/admission. None on any fault or when no eligible
+        peer exists: the caller sheds exactly as it would have."""
+        mh = self.multihost
+        from imaginary_tpu.fleet import router as router_mod
+
+        hint = str(request.headers.get(router_mod.ROUTE_HEADER, ""))
+        if hint.startswith("fwd"):
+            # arrived over a hop already: two critical hosts must shed,
+            # not ping-pong the same request between each other
+            return None
+        peer = mh.spill_target()
+        if peer is None:
+            return None
+        try:
+            body = await request.read()
+        except Exception:
+            return None
+        res = await mh.try_spill(peer, request.method, request.path_qs,
+                                 body, dict(request.headers))
+        if res is None:
+            return None
+        status, mime, rbody = res
+        return web.Response(body=rbody, status=status,
+                            content_type=mime or "application/octet-stream")
 
     async def _get_source_image(self, request: web.Request) -> bytes:
         try:
@@ -605,6 +669,49 @@ class ImageService:
             if tr is not None:
                 tr.annotate(cache="result_miss")
 
+        # --- cross-host routing: one HTTP hop to the owner HOST ------------
+        # Armed only with --peers (+ --router or a per-request route hint):
+        # host-level rendezvous elects one owner host per shared key, and a
+        # non-owner ships source bytes + resolved params one hop so the
+        # owner host's caches and intra-host ownership ring see every
+        # occurrence of the digest CLUSTER-wide. Placed after the local
+        # cache lookups (a local hit never pays a network hop) and before
+        # the intra-host forward (the receiving host runs its own). Any
+        # fault — dead host, fenced answer, hop timeout, injected
+        # peer.forward — falls through to local execution: no new 5xx.
+        mh = self.multihost
+        if mh is not None and not mh.note_hop_marker(request.headers):
+            rdigest = digest if digest is not None \
+                else cache_mod.source_digest(buf)
+            rkey = key if key is not None \
+                else cache_mod.request_key(rdigest, op_name, opts)
+            peer = mh.route_target(request.headers,
+                                   cache_mod.shared_key(rkey))
+            if peer is not None:
+                fwd_query = dict(request.query)
+                # the peer re-fetches nothing: source bytes ride the
+                # body, so source-identifying params must not
+                for p in ("url", "file", "sign"):
+                    fwd_query.pop(p, None)
+                if fwd_query.get("type") == "auto":
+                    # ship the NEGOTIATED type — the owner host has no
+                    # Accept header to re-run the negotiation against
+                    fwd_query["type"] = opts.type
+                fwd = await mh.try_forward(
+                    peer, op_name, fwd_query, buf,
+                    get_image_mime_type(sniffed))
+                if fwd is not None:
+                    out, placement = fwd
+                    if caches.result.enabled and key is not None:
+                        # promote: the next local occurrence skips the hop
+                        caches.result.put(key, (out, placement),
+                                          len(out.body))
+                    if tr is not None:
+                        tr.annotate(cache="host_forward",
+                                    placement=placement)
+                    return self._build_response(out, placement, vary,
+                                                etag, o)
+
         # --- fleet coherence: forward to the digest's owner ----------------
         # Armed only with --fleet-coherence: the rendezvous ring elects one
         # owner per shared key; a non-owner ships source bytes + resolved
@@ -738,7 +845,7 @@ class ImageService:
         inherit the client's clock."""
         flc = self.coherence
         shm = self.caches.shm
-        if flc is None or shm is None or shm.fenced():
+        if flc is None or shm is None or shm.fenced() or shm.host_fenced():
             # a deposed zombie must not compute for the fleet: refuse in
             # an orderly frame; the client falls back to local execution
             if flc is not None:
@@ -825,6 +932,14 @@ class ImageService:
             headers["Vary"] = vary
         if etag:
             headers["ETag"] = etag
+        if self.multihost is not None:
+            # incarnation stamp: a cross-host forwarder refuses answers
+            # whose epoch gossip has already deposed (fleet/router.py).
+            # Absent with --peers off — response byte parity.
+            from imaginary_tpu.fleet import router as router_mod
+
+            headers[router_mod.HOST_EPOCH_HEADER] = \
+                self.multihost.identity_header
         if o.return_size and out.mime != "application/json":
             # dims ride the result-cache meta (pipeline stamps plan
             # geometry into ProcessedImage), so the hot path re-probes
@@ -978,6 +1093,11 @@ def collect_health_stats(service: Optional[ImageService]) -> dict:
                 # ring view + forward/claim outcomes; the sub-dict's
                 # presence IS the --fleet-coherence armed signal
                 stats["fleet"]["coherence"] = service.coherence.snapshot()
+        if service.multihost is not None:
+            # cross-host plane (fleet/router.py): identity, route/spill
+            # outcome counters and the gossiped peer table; the block's
+            # presence IS the --peers armed signal
+            stats["multihost"] = service.multihost.snapshot()
         if service.options.read_timeout_s > 0:
             # ingress read-guard counters (web/ingress.py)
             from imaginary_tpu.web.ingress import STATS as ingress_stats

@@ -50,6 +50,15 @@ def get_health_stats(executor=None, qos=None, pressure=None,
     # the roll's ready-gate matches on (worker, epoch) since SO_REUSEPORT
     # makes the old and new holder of an index indistinguishable by port
     stats["epoch"] = worker_epoch()
+    # the host-level incarnation (fleet/multihost.py): present only when
+    # the multi-host plane stamped an identity into the env — absent =
+    # single-host parity, same presence-is-the-signal discipline as the
+    # blocks below
+    from imaginary_tpu.fleet import multihost
+
+    if multihost.host_id():
+        stats["host"] = {"id": multihost.host_id(),
+                         "epoch": multihost.host_epoch()}
     try:
         import jax
 

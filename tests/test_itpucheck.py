@@ -612,6 +612,52 @@ class TestLabelCardinality:
         assert _rules_hit(findings) == {"ITPU012"}
 
 
+class TestPeerTimeout:
+    def test_trips_urlopen_and_session_verbs_without_timeout(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import urllib.request\n"
+            "def gossip(url, session):\n"
+            "    urllib.request.urlopen(url)\n"  # no timeout at all
+            "    session.get(url, timeout=None)\n"  # unbounded, spelled out
+            "    session.post(url)\n"
+        )}, rules=["ITPU014"])
+        assert [f.line for f in findings] == [3, 4, 5]
+        assert _rules_hit(findings) == {"ITPU014"}
+
+    def test_aiohttp_oneshot_request_trips(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import aiohttp\n"
+            "async def hop(url):\n"
+            "    async with aiohttp.request('GET', url) as r:\n"
+            "        return await r.read()\n"
+        )}, rules=["ITPU014"])
+        assert [f.line for f in findings] == [3]
+
+    def test_bounded_calls_pass(self, tmp_path):
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "import urllib.request\n"
+            "import aiohttp\n"
+            "async def hop(url, session, budget):\n"
+            "    urllib.request.urlopen(url, timeout=1.0)\n"
+            "    session.get(url, timeout=budget)\n"
+            "    async with aiohttp.request('GET', url,\n"
+            "            timeout=aiohttp.ClientTimeout(total=budget)) as r:\n"
+            "        return await r.read()\n"
+        )}, rules=["ITPU014"])
+        assert findings == []
+
+    def test_plain_dict_get_is_not_http(self, tmp_path):
+        # the rule is about sockets, not maps: obj.get()/cache.get()
+        # without timeout= must never trip
+        findings, _ = _scan(tmp_path, {"m.py": (
+            "def read(table, peers, key):\n"
+            "    a = table.get(key)\n"
+            "    b = peers.get(key, None)\n"
+            "    return a or b\n"
+        )}, rules=["ITPU014"])
+        assert findings == []
+
+
 # -- suppression grammar ------------------------------------------------------
 
 
@@ -685,8 +731,8 @@ class TestJsonOutput:
         f = doc["findings"][0]
         assert set(f) == {"rule", "path", "line", "message"}
         assert f["rule"] == "ITPU001" and f["line"] == 3
-        # all 13 rules are advertised in the rule table
-        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 13
+        # all 14 rules are advertised in the rule table
+        assert len([r for r in doc["rules"] if r != "ITPU000"]) == 14
 
     def test_to_json_counts_suppressed(self, tmp_path):
         findings, suppressed = _scan(tmp_path, {"m.py": (

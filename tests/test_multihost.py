@@ -1,0 +1,844 @@
+"""Multi-host scale-out (ISSUE 20): host identity/epochs, peer gossip,
+cross-host rendezvous routing, pressure spillover, and the /fleetz
+cluster view.
+
+The table/router tests are pure-unit with injected clocks, fetches and
+hops (every rung of the fail-open ladder runs without a socket); the
+HTTP tests pin the OFF-state byte parity and run a real two-app
+cross-host forward over live aiohttp servers. The full two-SUPERVISOR
+cluster (separate processes, admin planes, gossip over real sockets)
+rides the slow e2e test here and chaos row 13 in `make chaos`.
+"""
+
+import asyncio
+import io
+import json
+import os
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from imaginary_tpu import cache as cache_mod
+from imaginary_tpu import failpoints
+from imaginary_tpu.fleet import multihost as mh
+from imaginary_tpu.fleet import router as router_mod
+from imaginary_tpu.fleet import shmcache
+from imaginary_tpu.fleet.shmcache import ShmCache
+from imaginary_tpu.obs import aggregate as agg
+from imaginary_tpu.obs import trace as obs_trace
+from imaginary_tpu.web.config import ServerOptions
+from tests.conftest import fixture_bytes
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fixtures(testdata):
+    return testdata
+
+
+@pytest.fixture(autouse=True)
+def _clean_host_env():
+    """The identity helpers stamp os.environ (the worker-inherit
+    contract); every test starts and ends unstamped so armed-state
+    leakage can never fake parity elsewhere in the suite."""
+    for env in (mh.HOST_ID_ENV, mh.HOST_EPOCH_ENV):
+        os.environ.pop(env, None)
+    yield
+    for env in (mh.HOST_ID_ENV, mh.HOST_EPOCH_ENV):
+        os.environ.pop(env, None)
+
+
+def _host_payload(hid="peer-b", epoch=5, serve="http://127.0.0.1:1",
+                  workers=2, queue=3.0, plevel=0):
+    return {"host": {"id": hid, "epoch": epoch, "serve_url": serve,
+                     "workers_alive": workers, "est_queue_ms": queue,
+                     "pressure_level": plevel}}
+
+
+# --- --peers grammar ---------------------------------------------------------
+
+
+class TestParsePeers:
+    def test_csv_whitespace_scheme_default_dedup(self):
+        got = mh.parse_peers(
+            " 10.0.0.2:9101, http://10.0.0.3:9101/ \n 10.0.0.2:9101")
+        assert got == ["http://10.0.0.2:9101", "http://10.0.0.3:9101"]
+
+    def test_at_file_with_comments(self, tmp_path):
+        f = tmp_path / "peers.txt"
+        f.write_text("# fleet\nhttp://a:1\n\nb:2  # second host\n")
+        assert mh.parse_peers("@" + str(f)) == ["http://a:1", "http://b:2"]
+
+    def test_unreadable_file_refuses(self, tmp_path):
+        with pytest.raises(ValueError):
+            mh.parse_peers("@" + str(tmp_path / "missing.txt"))
+
+    def test_empty_spec(self):
+        assert mh.parse_peers("") == []
+        assert mh.parse_peers("  ,  ") == []
+
+
+# --- host identity & epochs --------------------------------------------------
+
+
+class TestHostIdentity:
+    def test_unarmed_reads_empty(self):
+        assert mh.host_id() == ""
+        assert mh.host_epoch() == 0
+
+    def test_mint_strictly_greater_across_restarts(self):
+        t = [1000.0]
+        first = mh.mint_host_epoch(clock=lambda: t[0])
+        t[0] += 0.001  # even one ms later
+        assert mh.mint_host_epoch(clock=lambda: t[0]) > first
+
+    def test_ensure_stamps_once_and_children_inherit(self):
+        hid, epoch = mh.ensure_host_identity("host-a",
+                                             clock=lambda: 1234.5)
+        assert (hid, epoch) == ("host-a", 1234500)
+        assert os.environ[mh.HOST_ID_ENV] == "host-a"
+        # re-entry (a worker re-running main) keeps the incarnation:
+        # a worker must never mint its own host epoch
+        hid2, epoch2 = mh.ensure_host_identity("other",
+                                               clock=lambda: 9999.0)
+        assert (hid2, epoch2) == ("host-a", 1234500)
+
+    def test_default_id_is_hostname(self):
+        import socket
+
+        hid, _ = mh.ensure_host_identity("")
+        assert hid == socket.gethostname()
+
+    def test_garbage_epoch_env_reads_zero(self):
+        os.environ[mh.HOST_EPOCH_ENV] = "not-a-number"
+        assert mh.host_epoch() == 0
+
+
+# --- host rendezvous ---------------------------------------------------------
+
+
+class TestRendezvousHost:
+    def test_deterministic_and_all_hosts_used(self):
+        hosts = ["h1", "h2", "h3"]
+        keys = [b"k%d" % i for i in range(300)]
+        owners = [mh.rendezvous_host(hosts, k) for k in keys]
+        assert owners == [mh.rendezvous_host(hosts, k) for k in keys]
+        assert set(owners) == set(hosts)
+
+    def test_minimal_disruption_on_host_leave(self):
+        keys = [b"d%d" % i for i in range(300)]
+        before = {k: mh.rendezvous_host(["h1", "h2", "h3"], k)
+                  for k in keys}
+        after = {k: mh.rendezvous_host(["h1", "h3"], k) for k in keys}
+        for k in keys:
+            if before[k] != "h2":
+                assert after[k] == before[k]
+            else:
+                assert after[k] in ("h1", "h3")
+
+    def test_empty_is_none(self):
+        assert mh.rendezvous_host([], b"x") is None
+
+
+# --- peer table --------------------------------------------------------------
+
+
+class TestPeerTable:
+    def test_failed_poll_marks_dead_immediately(self):
+        t = mh.PeerTable(["http://p:1"], clock=lambda: 100.0)
+        t.observe("http://p:1", _host_payload())
+        assert len(t.alive()) == 1
+        t.observe("http://p:1", None)
+        p = t.peers()[0]
+        assert not p.alive and p.failures == 1
+        assert t.alive() == []
+
+    def test_staleness_is_a_read_side_judgement(self):
+        now = [100.0]
+        t = mh.PeerTable(["http://p:1"], staleness_s=5.0,
+                         clock=lambda: now[0])
+        t.observe("http://p:1", _host_payload())
+        assert len(t.alive()) == 1
+        now[0] += 20.0  # gossip wedged: no observe() ever marked it dead
+        assert t.alive() == []
+        assert t.lookup("peer-b") is None
+
+    def test_epoch_bump_counts_restarts(self):
+        t = mh.PeerTable(["http://p:1"], clock=lambda: 1.0)
+        t.observe("http://p:1", _host_payload(epoch=5))
+        t.observe("http://p:1", _host_payload(epoch=5))
+        assert t.peers()[0].epoch_bumps == 0
+        t.observe("http://p:1", _host_payload(epoch=9))
+        assert t.peers()[0].epoch_bumps == 1
+
+    def test_least_loaded_skips_critical_peers(self):
+        from imaginary_tpu.engine.pressure import LEVEL_CRITICAL
+
+        t = mh.PeerTable(["http://a:1", "http://b:1"], clock=lambda: 1.0)
+        t.observe("http://a:1", _host_payload(hid="a", queue=1.0,
+                                              plevel=LEVEL_CRITICAL))
+        t.observe("http://b:1", _host_payload(hid="b", queue=50.0))
+        got = t.least_loaded()
+        assert got is not None and got.host_id == "b"
+        t.observe("http://b:1", _host_payload(hid="b", queue=50.0,
+                                              plevel=LEVEL_CRITICAL))
+        assert t.least_loaded() is None
+
+    def test_lookup_by_host_id(self):
+        t = mh.PeerTable(["http://a:1"], clock=lambda: 1.0)
+        t.observe("http://a:1", _host_payload(hid="a"))
+        assert t.lookup("a").base == "http://a:1"
+        assert t.lookup("nobody") is None
+
+
+# --- gossip ------------------------------------------------------------------
+
+
+class TestGossip:
+    def test_poll_once_injectable_fetch(self):
+        t = mh.PeerTable(["http://good:1", "http://bad:1"],
+                         clock=lambda: 1.0)
+
+        def fetch(url, timeout):
+            assert timeout == mh.PEER_PROBE_TIMEOUT_S
+            if "good" in url:
+                return json.dumps(_host_payload(hid="g"))
+            return "not json {{{"
+
+        g = mh.GossipAgent(t, fetch=fetch)
+        g.poll_once()
+        assert g.polls == 1
+        by = {p.base: p for p in t.peers()}
+        assert by["http://good:1"].alive
+        assert not by["http://bad:1"].alive
+
+    def test_peer_health_failpoint_marks_dead(self):
+        t = mh.PeerTable(["http://p:1"], clock=lambda: 1.0)
+        g = mh.GossipAgent(
+            t, fetch=lambda u, to: json.dumps(_host_payload()))
+        failpoints.activate("peer.health=error")
+        try:
+            g.poll_once()
+        finally:
+            failpoints.deactivate()
+        assert t.alive() == []
+        g.poll_once()  # disarmed: the peer answers again
+        assert len(t.alive()) == 1
+
+
+# --- router: route decision + the fail-open hop ladder ----------------------
+
+
+def _router(table=None, **kw):
+    table = table or mh.PeerTable(["http://b:1"], clock=lambda: 1.0)
+    kw.setdefault("self_id", "host-a")
+    kw.setdefault("self_epoch", 100)
+    kw.setdefault("route_all", True)
+    return router_mod.HostRouter(table, **kw)
+
+
+def _owned_key(r, owner):
+    for i in range(2000):
+        k = b"key-%d" % i
+        if r.owner_host(k) == owner:
+            return k
+    raise AssertionError("no key owned by " + owner)
+
+
+def _ok_headers(peer):
+    return {router_mod.HOST_EPOCH_HEADER:
+            f"{peer.host_id}:{peer.host_epoch}",
+            "Content-Type": "image/jpeg",
+            "X-Imaginary-Backend": "tpu"}
+
+
+class TestRouteDecision:
+    def test_ladder(self):
+        r = _router()
+        r.table.observe("http://b:1", _host_payload(hid="host-b"))
+        k = _owned_key(r, "host-b")
+        # hop marker: arrived over the wire, must run locally
+        assert r.route_target({router_mod.ROUTE_HEADER: "fwd=x"}, k) is None
+        assert r.stats.served_for_peer == 0  # route_target doesn't book it
+        assert r.note_hop_marker({router_mod.ROUTE_HEADER: "fwd=x"})
+        assert r.stats.served_for_peer == 1
+        # client pin
+        assert r.route_target({router_mod.ROUTE_HEADER: "local"}, k) is None
+        # owned by the peer: forwarded
+        assert r.route_target({}, k).host_id == "host-b"
+        # self-owned keys stay local
+        assert r.route_target({}, _owned_key(r, "host-a")) is None
+
+    def test_router_off_requires_hint(self):
+        r = _router(route_all=False)
+        r.table.observe("http://b:1", _host_payload(hid="host-b"))
+        k = _owned_key(r, "host-b")
+        assert r.route_target({}, k) is None
+        assert r.route_target({router_mod.ROUTE_HEADER: "route"},
+                              k).host_id == "host-b"
+
+    def test_single_host_cluster_never_routes(self):
+        r = _router()  # peer never observed: table has no alive entry
+        assert r.owner_host(b"anything") is None
+        assert r.route_target({}, b"anything") is None
+
+    def test_dead_owner_falls_back_local(self):
+        now = [1.0]
+        t = mh.PeerTable(["http://b:1"], staleness_s=5.0,
+                         clock=lambda: now[0])
+        r = _router(table=t)
+        t.observe("http://b:1", _host_payload(hid="host-b"))
+        k = _owned_key(r, "host-b")
+        assert r.route_target({}, k) is not None
+        # rendezvous still elects host-b from the last-known membership,
+        # but gossip can no longer vouch for it -> local, counted
+        t.observe("http://b:1", None)
+        assert r.route_target({}, k) is None
+
+
+class TestForwardLadder:
+    def _peer(self, r):
+        r.table.observe("http://b:1",
+                        _host_payload(hid="host-b", epoch=7,
+                                      serve="http://b:2"))
+        return r.table.lookup("host-b")
+
+    def test_success_returns_processed_image(self):
+        calls = {}
+
+        async def hop(method, url, body, headers, timeout):
+            calls.update(method=method, url=url, body=body,
+                         headers=headers, timeout=timeout)
+            return 200, _ok_headers(self._peer(r)), b"JPEGBYTES"
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        got = asyncio.run(r.try_forward(
+            peer, "resize", {"width": "100"}, b"src", "image/jpeg"))
+        assert got is not None
+        out, placement = got
+        assert bytes(out.body) == b"JPEGBYTES"
+        assert out.mime == "image/jpeg"
+        assert placement == "tpu"
+        assert r.stats.forwards == 1
+        assert calls["method"] == "POST"
+        assert calls["url"].startswith("http://b:2/resize?")
+        assert calls["headers"][router_mod.ROUTE_HEADER] == "fwd=host-a"
+        assert 0 < calls["timeout"] <= r.hop_s
+
+    def test_non_200_fails_open(self):
+        async def hop(*a, **kw):
+            return 503, {}, b"shed"
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        assert asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                         "image/jpeg")) is None
+        assert r.stats.forward_fails == 1
+
+    def test_hop_exception_fails_open(self):
+        async def hop(*a, **kw):
+            raise OSError("connection refused")
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        assert asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                         "image/jpeg")) is None
+        assert r.stats.forward_fails == 1
+
+    def test_stale_host_epoch_answer_is_fenced(self):
+        async def hop(*a, **kw):
+            return 200, {router_mod.HOST_EPOCH_HEADER: "host-b:3",
+                         "Content-Type": "image/jpeg"}, b"old"
+
+        r = _router(hop=hop)
+        peer = self._peer(r)  # gossip knows epoch 7; the answer says 3
+        assert asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                         "image/jpeg")) is None
+        assert r.stats.fenced_answers == 1
+        assert r.stats.forwards == 0
+
+    def test_missing_epoch_stamp_is_fenced(self):
+        async def hop(*a, **kw):
+            return 200, {"Content-Type": "image/jpeg"}, b"x"
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        assert asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                         "image/jpeg")) is None
+        assert r.stats.fenced_answers == 1
+
+    def test_exhausted_deadline_never_dials(self):
+        async def hop(*a, **kw):
+            raise AssertionError("dialed with no budget")
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        from imaginary_tpu import deadline as deadline_mod
+
+        tr = obs_trace.RequestTrace(request_id="t", enabled=False)
+        tr.deadline = deadline_mod.Deadline(0.001,
+                                            t0=time.monotonic() - 1.0)
+        token = obs_trace.activate(tr)
+        try:
+            got = asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                            "image/jpeg"))
+        finally:
+            obs_trace.deactivate(token)
+        assert got is None
+        assert r.stats.forward_fails == 1
+
+    def test_deadline_clamps_hop_budget(self):
+        seen = {}
+
+        async def hop(method, url, body, headers, timeout):
+            seen["timeout"] = timeout
+            return 200, _ok_headers(self._peer(r)), b"x"
+
+        r = _router(hop=hop, hop_s=30.0)
+        peer = self._peer(r)
+        from imaginary_tpu import deadline as deadline_mod
+
+        tr = obs_trace.RequestTrace(request_id="t", enabled=False)
+        tr.deadline = deadline_mod.Deadline(0.5)
+        token = obs_trace.activate(tr)
+        try:
+            asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                      "image/jpeg"))
+        finally:
+            obs_trace.deactivate(token)
+        assert seen["timeout"] <= 0.5
+
+    def test_peer_forward_failpoint_fails_open_without_dialing(self):
+        async def hop(*a, **kw):
+            raise AssertionError("failpoint must fire before the dial")
+
+        r = _router(hop=hop)
+        peer = self._peer(r)
+        failpoints.activate("peer.forward[host-b]=error")
+        try:
+            got = asyncio.run(r.try_forward(peer, "resize", {}, b"s",
+                                            "image/jpeg"))
+        finally:
+            failpoints.deactivate()
+        assert got is None
+        assert r.stats.forward_fails == 1
+
+
+class TestSpillover:
+    def test_spill_target_is_least_loaded_noncritical(self):
+        from imaginary_tpu.engine.pressure import LEVEL_CRITICAL
+
+        t = mh.PeerTable(["http://b:1", "http://c:1"], clock=lambda: 1.0)
+        r = _router(table=t)
+        assert r.spill_target() is None  # nobody alive yet
+        t.observe("http://b:1", _host_payload(hid="b", queue=9.0))
+        t.observe("http://c:1", _host_payload(hid="c", queue=2.0))
+        assert r.spill_target().host_id == "c"
+        t.observe("http://c:1", _host_payload(hid="c", queue=2.0,
+                                              plevel=LEVEL_CRITICAL))
+        assert r.spill_target().host_id == "b"
+
+    def test_try_spill_roundtrip_and_fail_open(self):
+        async def ok_hop(method, url, body, headers, timeout):
+            assert method == "GET"
+            assert url == "http://b:2/resize?width=9&url=x"
+            assert headers[router_mod.ROUTE_HEADER] == "fwd=host-a"
+            return 200, _ok_headers(peer), b"BODY"
+
+        r = _router(hop=ok_hop)
+        r.table.observe("http://b:1",
+                        _host_payload(hid="host-b", epoch=7,
+                                      serve="http://b:2"))
+        peer = r.table.lookup("host-b")
+        got = asyncio.run(r.try_spill(peer, "GET",
+                                      "/resize?width=9&url=x", b"",
+                                      {"Accept": "image/webp"}))
+        assert got == (200, "image/jpeg", b"BODY")
+        assert r.stats.spills == 1
+
+        async def shed_hop(*a, **kw):
+            return 503, {}, b"shed there too"
+
+        r2 = _router(hop=shed_hop)
+        r2.table.observe("http://b:1",
+                         _host_payload(hid="host-b", serve="http://b:2"))
+        peer2 = r2.table.lookup("host-b")
+        assert asyncio.run(r2.try_spill(peer2, "GET", "/x", b"",
+                                        {})) is None
+        assert r2.stats.spill_fails == 1
+
+
+# --- shm host epoch ----------------------------------------------------------
+
+
+class TestShmHostEpoch:
+    def test_stamp_roundtrip_and_host_fencing(self, tmp_path):
+        path = str(tmp_path / "fleet.shm")
+        sup = ShmCache(path, create=True, size_mb=1.0, owner=True)
+        try:
+            assert sup.host_epoch_stamp() == 0
+            assert not sup.host_fenced()  # unarmed: never fenced
+            sup.stamp_host_epoch(500)
+            assert sup.host_epoch_stamp() == 500
+            # this process was born into incarnation 400: deposed
+            os.environ[mh.HOST_EPOCH_ENV] = "400"
+            assert sup.host_fenced()
+            # the current incarnation (or a newer one) is never fenced
+            os.environ[mh.HOST_EPOCH_ENV] = "500"
+            assert not sup.host_fenced()
+        finally:
+            sup.close()
+
+    def test_creator_stamps_armed_host_epoch(self, tmp_path):
+        os.environ[mh.HOST_EPOCH_ENV] = "777"
+        path = str(tmp_path / "fleet2.shm")
+        sup = ShmCache(path, create=True, size_mb=1.0, owner=True)
+        try:
+            assert sup.host_epoch_stamp() == 777
+        finally:
+            sup.close()
+
+
+# --- /fleetz host block + cluster view ---------------------------------------
+
+
+class TestFleetzCluster:
+    def test_build_fleetz_host_block_rollup(self):
+        view = {0: {"pid": 1, "alive": True, "epoch": 1},
+                1: {"pid": 2, "alive": False, "epoch": 1}}
+        health = {0: {"estimatedQueueMs": 12.5,
+                      "pressure": {"state": 1}}}
+        out = agg.build_fleetz(view, health, set(),
+                               host={"id": "h-a", "epoch": 9,
+                                     "serve_url": "http://h-a:1"})
+        assert out["host"] == {"id": "h-a", "epoch": 9,
+                               "serve_url": "http://h-a:1",
+                               "workers_alive": 1, "est_queue_ms": 12.5,
+                               "pressure_level": 1}
+        # parity: no host argument, no host block
+        assert "host" not in agg.build_fleetz(view, health, set())
+
+    def test_cluster_view_merges_local_and_peers(self):
+        t = mh.PeerTable(["http://b:1", "http://c:1"], clock=lambda: 1.0)
+        t.observe("http://b:1", _host_payload(hid="b", epoch=4))
+        # c never answered: appears dead, fleetz withheld
+        local = agg.build_fleetz({}, {}, set(),
+                                 host={"id": "a", "epoch": 2,
+                                       "serve_url": "u"})
+        out = mh.build_cluster_view(local, t)
+        assert out["scope"] == "cluster"
+        assert out["hosts"]["a"]["local"] is True
+        assert out["hosts"]["b"]["alive"] is True
+        assert out["peers"]["http://b:1"]["fleetz"] is not None
+        assert out["peers"]["http://c:1"]["fleetz"] is None
+        assert out["local"] is local
+
+
+# --- HTTP: parity, surfaces, live cross-host forward -------------------------
+
+
+def run(options, fn):
+    async def runner():
+        from imaginary_tpu.web.app import create_app
+
+        app = create_app(options, log_stream=io.StringIO())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, app)
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+
+
+def jpg() -> bytes:
+    return fixture_bytes("imaginary.jpg")
+
+
+def _post_kw():
+    return {"data": jpg(), "headers": {"Content-Type": "image/jpeg"}}
+
+
+class TestMultihostHttp:
+    def test_peers_off_byte_parity(self):
+        os.environ.pop(shmcache.PATH_ENV, None)
+        bodies = {}
+
+        async def baseline(client, app):
+            r = await client.post("/resize?width=140", **_post_kw())
+            bodies["off"] = await r.read()
+            assert router_mod.HOST_EPOCH_HEADER not in r.headers
+            h = await (await client.get("/health")).json()
+            assert "multihost" not in h and "host" not in h
+            assert app["service"].multihost is None
+            # no peers = no identity stamps, no gossip thread
+            assert mh.host_id() == ""
+            assert not any(t.name == "peer-gossip"
+                           for t in __import__("threading").enumerate())
+
+        async def armed(client, app):
+            r = await client.post("/resize?width=140", **_post_kw())
+            bodies["on"] = await r.read()
+            svc = app["service"]
+            assert r.headers[router_mod.HOST_EPOCH_HEADER] == \
+                svc.multihost.identity_header
+            h = await (await client.get("/health")).json()
+            assert h["host"]["id"] == "parity-host"
+            assert h["multihost"]["host_id"] == "parity-host"
+            assert h["multihost"]["router"] is False
+
+        run(ServerOptions(), baseline)
+        run(ServerOptions(peers="http://127.0.0.1:1",
+                          host_id="parity-host"), armed)
+        assert bodies["off"] == bodies["on"]
+
+    def test_unreachable_peer_fails_open(self):
+        # --router armed, the only peer dead: every request runs local,
+        # same bytes, no new error class
+        async def armed(client, app):
+            r = await client.post("/resize?width=133", **_post_kw())
+            assert r.status == 200
+            h = await (await client.get("/health")).json()
+            assert h["multihost"]["forwards"] == 0
+
+        run(ServerOptions(peers="http://127.0.0.1:1", router=True,
+                          host_id="solo"), armed)
+
+    def test_forward_e2e_between_two_hosts(self):
+        # two real apps, distinct host identities, routing armed on A:
+        # a request for a digest B owns takes one real HTTP hop and
+        # serves B's bytes; B books served_for_peer and never re-routes
+        async def fn():
+            from imaginary_tpu.web.app import create_app
+
+            def boot(hid):
+                os.environ[mh.HOST_ID_ENV] = hid
+                os.environ[mh.HOST_EPOCH_ENV] = str(100)
+                try:
+                    return create_app(
+                        ServerOptions(peers="http://127.0.0.1:1",
+                                      router=True, host_id=hid,
+                                      fleet_hop_ms=15000.0),
+                        log_stream=io.StringIO())
+                finally:
+                    os.environ.pop(mh.HOST_ID_ENV, None)
+                    os.environ.pop(mh.HOST_EPOCH_ENV, None)
+
+            app_a, app_b = boot("host-a"), boot("host-b")
+            ca = TestClient(TestServer(app_a))
+            cb = TestClient(TestServer(app_b))
+            await ca.start_server()
+            await cb.start_server()
+            try:
+                ra = app_a["service"].multihost
+                rb = app_b["service"].multihost
+                # cross-teach the tables by hand (gossip would need two
+                # admin planes; the table API is the contract)
+                ra.table.observe(
+                    "http://127.0.0.1:1",
+                    _host_payload(hid="host-b", epoch=100,
+                                  serve=str(cb.make_url("")).rstrip("/")))
+                body = jpg()
+                digest = cache_mod.source_digest(body)
+                from imaginary_tpu.params import build_params_from_query
+
+                width = None
+                for cand in range(60, 300):
+                    opts = build_params_from_query({"width": str(cand)})
+                    skey = cache_mod.shared_key(
+                        cache_mod.request_key(digest, "resize", opts))
+                    if ra.owner_host(skey) == "host-b":
+                        width = cand
+                        break
+                assert width is not None
+                fwd = await ca.post(f"/resize?width={width}", **_post_kw())
+                assert fwd.status == 200
+                assert fwd.headers[router_mod.HOST_EPOCH_HEADER] == \
+                    "host-a:100"
+                b_fwd = await fwd.read()
+                assert ra.stats.forwards == 1
+                assert rb.stats.served_for_peer == 1
+                assert rb.stats.forwards == 0  # one hop, ever
+                direct = await cb.post(f"/resize?width={width}",
+                                       **_post_kw())
+                assert await direct.read() == b_fwd
+            finally:
+                await ca.close()
+                await cb.close()
+
+        asyncio.run(fn())
+
+    def test_spillover_offers_before_shedding(self):
+        # force A's governor critical (memory.rss chaos site) and point
+        # its table at a healthy B: batch-class work that would 503 on A
+        # ships to B and answers 200; with B critical too, A sheds the
+        # 503 the request was owed anyway (no ping-pong)
+        qos_cfg = json.dumps({
+            "default": {"class": "standard"},
+            "tenants": [{"name": "bulk", "class": "batch",
+                         "api_keys": ["bulk-key"]}],
+        })
+
+        async def fn():
+            from imaginary_tpu.web.app import create_app
+
+            def boot(hid, pressure):
+                os.environ[mh.HOST_ID_ENV] = hid
+                os.environ[mh.HOST_EPOCH_ENV] = "100"
+                try:
+                    o = ServerOptions(
+                        peers="http://127.0.0.1:1", host_id=hid,
+                        fleet_hop_ms=15000.0, qos_config=qos_cfg,
+                        pressure_rss_mb=1_000_000.0 if pressure else 0.0)
+                    return create_app(o, log_stream=io.StringIO())
+                finally:
+                    os.environ.pop(mh.HOST_ID_ENV, None)
+                    os.environ.pop(mh.HOST_EPOCH_ENV, None)
+
+            app_a, app_b = boot("host-a", True), boot("host-b", False)
+            ca = TestClient(TestServer(app_a))
+            cb = TestClient(TestServer(app_b))
+            await ca.start_server()
+            await cb.start_server()
+            try:
+                svc_a = app_a["service"]
+                ra = svc_a.multihost
+                serve_b = str(cb.make_url("")).rstrip("/")
+                ra.table.observe(
+                    "http://127.0.0.1:1",
+                    _host_payload(hid="host-b", epoch=100,
+                                  serve=serve_b))
+                svc_a.pressure.config.sample_interval_s = 0.0
+                failpoints.activate("memory.rss=error")
+                try:
+                    from imaginary_tpu.engine.pressure import \
+                        LEVEL_CRITICAL
+
+                    assert svc_a.pressure.level() == LEVEL_CRITICAL
+                    r = await ca.post("/resize?width=123&key=bulk-key",
+                                      **_post_kw())
+                    assert r.status == 200  # spilled, not shed
+                    assert ra.stats.spills == 1
+                    rb = app_b["service"].multihost
+                    assert rb.stats.served_for_peer >= 1
+                    assert rb.stats.spills == 0  # marker blocks re-spill
+                    # B at critical too: no spill target, A sheds 503
+                    ra.table.observe(
+                        "http://127.0.0.1:1",
+                        _host_payload(hid="host-b", epoch=100,
+                                      plevel=LEVEL_CRITICAL,
+                                      serve=serve_b))
+                    r2 = await ca.post("/resize?width=124&key=bulk-key",
+                                       **_post_kw())
+                    assert r2.status == 503
+                    assert "Retry-After" in r2.headers
+                finally:
+                    failpoints.deactivate()
+            finally:
+                await ca.close()
+                await cb.close()
+
+        asyncio.run(fn())
+
+
+# --- two real supervisors (subprocess e2e) -----------------------------------
+
+
+@pytest.mark.slow
+def test_two_supervisor_cluster_forward():
+    """The full stack, no shortcuts: two `python -m imaginary_tpu.cli`
+    clusters on one machine, each a supervisor + worker with its own
+    admin plane, cross-pointed --peers, --router on. Gossip learns the
+    peer over real sockets; a digest owned by the other host takes a
+    real cross-host hop."""
+    import subprocess
+    import sys
+    import urllib.request
+
+    import bench_util
+
+    ports = [bench_util.free_port() for _ in range(4)]
+    sp_a, sp_b, ad_a, ad_b = ports
+    env = dict(os.environ)
+    env.pop(mh.HOST_ID_ENV, None)
+    env.pop(mh.HOST_EPOCH_ENV, None)
+    env.pop(shmcache.PATH_ENV, None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    # two workers per host: the supervisor path (admin plane, shm fleet
+    # cache, gossip thread) is exactly what production multi-host runs
+    def start_host(hid, port, admin, peer_admin):
+        e = dict(env)
+        return subprocess.Popen(
+            [sys.executable, "-m", "imaginary_tpu.cli", "--workers", "2",
+             "--port", str(port), "--host-id", hid,
+             "--peers", f"http://127.0.0.1:{peer_admin}",
+             "--router", "--fleet-hop-ms", "15000",
+             "--peer-probe-interval", "0.3",
+             "--fleet-cache-mb", "8", "--fleet-admin-port", str(admin),
+             "--cache-result-mb", "8"],
+            env=e, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+    pa = start_host("host-a", sp_a, ad_a, ad_b)
+    pb = start_host("host-b", sp_b, ad_b, ad_a)
+    try:
+        def wait_http(url, deadline=90.0):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < deadline:
+                try:
+                    with urllib.request.urlopen(url, timeout=2.0) as r:
+                        return json.loads(r.read().decode())
+                except Exception:
+                    time.sleep(0.3)
+            raise AssertionError("never healthy: " + url)
+
+        ha = wait_http(f"http://127.0.0.1:{sp_a}/health")
+        wait_http(f"http://127.0.0.1:{sp_b}/health")
+        assert ha["host"]["id"] == "host-a"
+        # cluster view converges once gossip has crossed
+        t0 = time.monotonic()
+        cluster = {}
+        while time.monotonic() - t0 < 30.0:
+            cluster = wait_http(
+                f"http://127.0.0.1:{ad_a}/fleetz?scope=cluster")
+            if cluster.get("hosts", {}).get("host-b", {}).get("alive"):
+                break
+            time.sleep(0.5)
+        assert cluster["hosts"]["host-b"]["alive"] is True
+        assert cluster["hosts"]["host-a"]["local"] is True
+
+        # worker gossip rides the same admin planes; give the workers a
+        # beat to see host-b alive, then hunt a width A must forward
+        body = fixture_bytes("imaginary.jpg")
+        deadline = time.monotonic() + 45.0
+        forwarded = False
+        while time.monotonic() < deadline and not forwarded:
+            for width in range(90, 130):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{sp_a}/resize?width={width}",
+                    data=body, method="POST",
+                    headers={"Content-Type": "image/jpeg",
+                             "Connection": "close"})
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    assert r.status == 200
+            h = wait_http(f"http://127.0.0.1:{sp_a}/health")
+            if h.get("multihost", {}).get("forwards", 0) > 0:
+                forwarded = True
+        assert forwarded, "no request ever took the cross-host hop"
+    finally:
+        import signal as _signal
+
+        for p in (pa, pb):
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in (pa, pb):
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
